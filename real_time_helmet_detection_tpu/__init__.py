@@ -1,0 +1,14 @@
+"""TPU-native CenterNet-style helmet/person detector framework.
+
+A brand-new JAX/XLA/Pallas/pjit implementation with the capabilities of the
+reference PyTorch project (tyui592/Real_Time_Helmet_Detection): stacked
+hourglass backbone, heatmap/offset/size GT encoding, focal + normed-L1
+losses, data-parallel training over a `jax.sharding.Mesh`, fixed-shape
+jit-able decoding + NMS, VOC-mAP evaluation, orbax checkpointing, StableHLO
+export, and a native C++ inference runner.
+
+Layout convention: NHWC (channels last) everywhere on device — the natural
+layout for TPU convolutions — whereas the reference is NCHW.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,27 @@
+from .hourglass import (
+    Activation,
+    Convolution,
+    Head,
+    Hourglass,
+    Neck,
+    Pool,
+    PreLayer,
+    Residual,
+    SPP,
+    StackedHourglass,
+    mish,
+)
+
+__all__ = [
+    "Activation",
+    "Convolution",
+    "Head",
+    "Hourglass",
+    "Neck",
+    "Pool",
+    "PreLayer",
+    "Residual",
+    "SPP",
+    "StackedHourglass",
+    "mish",
+]

@@ -1,0 +1,325 @@
+"""Stacked-hourglass CenterNet backbone in flax.linen, NHWC, TPU-first.
+
+Capability parity with the reference model zoo (/root/reference/hourglass.py):
+`Mish`:6, `Activation`:14, `SPP`:46, `Pool`:68, `Convolution`:94,
+`Residual`:111, recursive `Hourglass`:130, `PreLayer`:159, `Neck`:176,
+`Head`:189, `StackedHourglass`:198 — re-designed rather than translated:
+
+* **NHWC layout** end to end (TPU conv native layout; reference is NCHW);
+* shape law: `(B, num_stack, H/4, W/4, num_cls + 4)` — the reference's
+  `(B, S, C+4, H/4, W/4)` with channels moved last;
+* a `dtype` policy attribute on every block for bf16 compute with fp32
+  params/batch-stats (the TPU-native replacement for CUDA AMP + GradScaler:
+  bf16 needs no loss scaling);
+* explicit symmetric `(k-1)//2` padding to preserve the reference's exact
+  spatial geometry (XLA `SAME` pads asymmetrically for stride-2 convs);
+* nearest 2x upsampling as a pure `jnp.repeat` (exact, fusable).
+
+BatchNorm uses per-replica batch statistics under data parallelism, matching
+DDP's default (SURVEY.md §7 hard parts); pass `bn_axis_name` to opt into
+cross-replica sync-BN, a capability the reference lacks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+Dtype = Any
+
+
+def mish(x: jax.Array) -> jax.Array:
+    """x * tanh(softplus(x)) (ref hourglass.py:6-11)."""
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+class Activation(nn.Module):
+    """Activation factory (ref hourglass.py:14-43).
+
+    Supported: ReLU | LReLU | PReLU | Linear | Mish | Sigmoid | CELU.
+    """
+    activation: str = "ReLU"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        name = self.activation
+        if name == "ReLU":
+            return nn.relu(x)
+        if name == "LReLU":
+            return nn.leaky_relu(x, negative_slope=0.01)
+        if name == "PReLU":
+            # torch's nn.PReLU initializes the slope at 0.25; flax defaults
+            # to 0.01, which would silently change training dynamics.
+            return nn.PReLU(negative_slope_init=0.25)(x)
+        if name == "Linear":
+            return x
+        if name == "Mish":
+            return mish(x)
+        if name == "Sigmoid":
+            return nn.sigmoid(x)
+        if name == "CELU":
+            return nn.celu(x)
+        raise NotImplementedError("Not expected activation: %s" % name)
+
+
+def _max_pool_same(x: jax.Array, k: int) -> jax.Array:
+    """k x k stride-1 max pool with symmetric (k-1)//2 padding."""
+    p = (k - 1) // 2
+    return nn.max_pool(x, (k, k), strides=(1, 1), padding=((p, p), (p, p)))
+
+
+class SPP(nn.Module):
+    """YOLOv4-style spatial pyramid pooling (ref hourglass.py:46-65):
+    1x1 channel-halving conv -> parallel stride-1 max pools k in
+    {5, 9, 13} -> concat -> 1x1 conv back to `ch`. Keeps resolution."""
+    ch: int = 128
+    kernel_sizes: Sequence[int] = (5, 9, 13)
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        half = self.ch // 2
+        x = nn.Conv(half, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        pooled = [x] + [_max_pool_same(x, k) for k in self.kernel_sizes]
+        y = jnp.concatenate(pooled, axis=-1)
+        return nn.Conv(self.ch, (1, 1), use_bias=False, dtype=self.dtype)(y)
+
+
+class Pool(nn.Module):
+    """Downsample factory (ref hourglass.py:68-91): Max | Avg | Conv | SPP |
+    None. Note (as in the reference): SPP keeps resolution; 'None' is
+    identity."""
+    channel: int
+    pool: str = "Max"
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        name = self.pool
+        if name == "Max":
+            return nn.max_pool(x, (2, 2), strides=(2, 2))
+        if name == "Avg":
+            return nn.avg_pool(x, (2, 2), strides=(2, 2))
+        if name == "Conv":
+            return nn.Conv(self.channel, (2, 2), strides=(2, 2), padding="VALID",
+                           dtype=self.dtype)(x)
+        if name == "SPP":
+            return SPP(self.channel, dtype=self.dtype)(x)
+        if name == "None":
+            return x
+        raise NotImplementedError("Not expected pool: %s" % name)
+
+
+class Convolution(nn.Module):
+    """Conv -> optional BN -> activation (ref hourglass.py:94-108), with the
+    reference's symmetric (k-1)//2 padding."""
+    out_ch: int
+    kernel_size: int = 3
+    stride: int = 1
+    use_bias: bool = True
+    bn: bool = False
+    activation: str = "ReLU"
+    dtype: Optional[Dtype] = None
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        k, p = self.kernel_size, (self.kernel_size - 1) // 2
+        x = nn.Conv(self.out_ch, (k, k), strides=(self.stride, self.stride),
+                    padding=((p, p), (p, p)), use_bias=self.use_bias,
+                    dtype=self.dtype)(x)
+        if self.bn:
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             epsilon=1e-5, dtype=self.dtype,
+                             axis_name=self.bn_axis_name)(x)
+        return Activation(self.activation)(x)
+
+
+class Residual(nn.Module):
+    """Two 3x3 BN convs (second linear) + 1x1 BN skip on channel change,
+    post-add activation (ref hourglass.py:111-127)."""
+    out_ch: int
+    kernel_size: int = 3
+    stride: int = 1
+    activation: str = "ReLU"
+    dtype: Optional[Dtype] = None
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        y = Convolution(self.out_ch, self.kernel_size, self.stride,
+                        use_bias=False, bn=True, activation=self.activation,
+                        **kw)(x, train)
+        y = Convolution(self.out_ch, self.kernel_size, self.stride,
+                        use_bias=False, bn=True, activation="Linear",
+                        **kw)(y, train)
+        if x.shape[-1] != self.out_ch:
+            x = Convolution(self.out_ch, 1, self.stride, use_bias=False,
+                            bn=True, activation="Linear", **kw)(x, train)
+        return Activation(self.activation)(y + x)
+
+
+def _upsample_nearest_2x(x: jax.Array) -> jax.Array:
+    return jnp.repeat(jnp.repeat(x, 2, axis=-3), 2, axis=-2)
+
+
+class Hourglass(nn.Module):
+    """Recursive U-module of depth `num_layer` (ref hourglass.py:130-156):
+    residual skip + [pool -> residual(+increase_ch) -> recurse/bottom ->
+    residual(back down) -> nearest-2x up], summed."""
+    num_layer: int
+    in_ch: int
+    increase_ch: int = 0
+    activation: str = "ReLU"
+    pool: str = "Max"
+    dtype: Optional[Dtype] = None
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        kw = dict(activation=self.activation, dtype=self.dtype,
+                  bn_axis_name=self.bn_axis_name)
+        mid_ch = self.in_ch + self.increase_ch
+
+        up1 = Residual(self.in_ch, **kw)(x, train)
+        low = Pool(self.in_ch, self.pool, dtype=self.dtype)(x)
+        low = Residual(mid_ch, **kw)(low, train)
+        if self.num_layer > 1:
+            low = Hourglass(self.num_layer - 1, mid_ch, self.increase_ch,
+                            self.activation, self.pool, self.dtype,
+                            self.bn_axis_name)(low, train)
+        else:
+            low = Residual(mid_ch, **kw)(low, train)
+        low = Residual(self.in_ch, **kw)(low, train)
+        if self.pool in ("SPP", "None"):
+            # resolution was never reduced; no upsample (matches the
+            # reference geometry where Pool is non-downsampling)
+            up2 = low
+        else:
+            up2 = _upsample_nearest_2x(low)
+        return up1 + up2
+
+
+class PreLayer(nn.Module):
+    """Stem: fixed 4x downsample (ref hourglass.py:159-173):
+    7x7 s2 conv(64, BN) -> Residual(mid) -> Pool(2x) -> Residual(mid) ->
+    Residual(out)."""
+    mid_ch: int = 128
+    out_ch: int = 128
+    activation: str = "ReLU"
+    pool: str = "Max"
+    dtype: Optional[Dtype] = None
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = Convolution(64, 7, 2, use_bias=True, bn=True,
+                        activation=self.activation, **kw)(x, train)
+        x = Residual(self.mid_ch, **kw)(x, train)
+        x = Pool(self.mid_ch, self.pool, dtype=self.dtype)(x)
+        x = Residual(self.mid_ch, **kw)(x, train)
+        x = Residual(self.out_ch, **kw)(x, train)
+        return x
+
+
+class Neck(nn.Module):
+    """Feature neck (ref hourglass.py:176-186): optional Pool (None | SPP) ->
+    1x1 BN conv -> Residual."""
+    ch: int = 128
+    activation: str = "ReLU"
+    pool: str = "None"
+    dtype: Optional[Dtype] = None
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = Pool(self.ch, self.pool, dtype=self.dtype)(x)
+        x = Convolution(self.ch, 1, bn=True, activation=self.activation,
+                        **kw)(x, train)
+        x = Residual(self.ch, **kw)(x, train)
+        return x
+
+
+class Head(nn.Module):
+    """Prediction head: single 1x1 linear conv (ref hourglass.py:189-195)."""
+    out_ch: int
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return Convolution(self.out_ch, 1, 1, use_bias=True, bn=False,
+                           activation="Linear", dtype=self.dtype)(x)
+
+
+class StackedHourglass(nn.Module):
+    """Full detector (ref hourglass.py:198-237).
+
+    forward: PreLayer -> per stack [Hourglass -> Neck -> Head], keeping every
+    stack's prediction for deep supervision; between stacks
+    `x = x + merge_feature(feature) + merge_prediction(prediction)`.
+
+    Returns `(B, num_stack, H/4, W/4, out_ch)` float32 logits (raw — sigmoid
+    is applied by the loss/decode callers, as in the reference).
+    """
+    num_stack: int = 1
+    in_ch: int = 128
+    out_ch: int = 6  # num_cls + 4
+    increase_ch: int = 0
+    activation: str = "ReLU"
+    pool: str = "Max"
+    neck_activation: str = "ReLU"
+    neck_pool: str = "None"
+    dtype: Optional[Dtype] = None
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+        x = PreLayer(mid_ch=128, out_ch=self.in_ch, activation=self.activation,
+                     pool=self.pool, **kw)(x, train)
+
+        predictions = []
+        for i in range(self.num_stack):
+            hg = Hourglass(num_layer=4, in_ch=self.in_ch,
+                           increase_ch=self.increase_ch,
+                           activation=self.activation, pool=self.pool,
+                           **kw)(x, train)
+            feature = Neck(self.in_ch, self.neck_activation, self.neck_pool,
+                           **kw)(hg, train)
+            prediction = Head(self.out_ch, dtype=self.dtype)(feature)
+            predictions.append(prediction)
+            if i < self.num_stack - 1:
+                x = (x
+                     + Convolution(self.in_ch, 1, 1, use_bias=True, bn=False,
+                                   activation="Linear", dtype=self.dtype)(feature)
+                     + Convolution(self.in_ch, 1, 1, use_bias=True, bn=False,
+                                   activation="Linear", dtype=self.dtype)(prediction))
+
+        return jnp.stack(predictions, axis=1).astype(jnp.float32)
+
+
+def build_model(args_or_cfg, dtype: Optional[Dtype] = None,
+                bn_axis_name: Optional[str] = None) -> StackedHourglass:
+    """Construct the detector from a config namespace with the reference's
+    flag names (ref train.py:164-172 `load_network`)."""
+    c = args_or_cfg
+    return StackedHourglass(
+        num_stack=c.num_stack,
+        in_ch=c.hourglass_inch,
+        out_ch=c.num_cls + 4,
+        increase_ch=c.increase_ch,
+        activation=c.activation,
+        pool=c.pool,
+        neck_activation=c.neck_activation,
+        neck_pool=c.neck_pool,
+        dtype=dtype,
+        bn_axis_name=bn_axis_name,
+    )

@@ -1,0 +1,19 @@
+from .encode import encode_boxes, encode_boxes_batch, encode_boxes_jax, gaussian_radius
+from .decode import decode_heatmap, peak_mask
+from .loss import focal_loss, normed_l1_loss, detection_loss, LossLog
+from .nms import nms_mask, soft_nms_mask
+
+__all__ = [
+    "encode_boxes",
+    "encode_boxes_batch",
+    "encode_boxes_jax",
+    "gaussian_radius",
+    "decode_heatmap",
+    "peak_mask",
+    "focal_loss",
+    "normed_l1_loss",
+    "detection_loss",
+    "LossLog",
+    "nms_mask",
+    "soft_nms_mask",
+]

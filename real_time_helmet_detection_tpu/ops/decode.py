@@ -1,0 +1,106 @@
+"""Heatmap -> boxes decoding, fully jit-able with static shapes.
+
+Capability parity with the reference decoder (/root/reference/transform.py:73-110
+`hm2box`): 3x3 max-pool peak test, flat top-k over (C, H, W), offset/size
+gather, un-normalization, box reconstruction, confidence thresholding.
+
+TPU-first differences:
+  * channels-last `(H, W, C)` inputs;
+  * **fixed output shapes**: always returns `topk` boxes plus a validity mask
+    (`score >= conf_th`) instead of boolean-filtering to a data-dependent
+    length — the mask is applied downstream (NMS is masked too, and the
+    final txt writer filters host-side);
+  * the peak test + top-k is the designated fusion target for a Pallas TPU
+    kernel (planned: `ops/pallas/`); this module is the XLA path it will be
+    benchmarked against.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Detections(NamedTuple):
+    """Fixed-size decoded detections for one image."""
+    boxes: jax.Array   # (topk, 4) xyxy at image scale
+    classes: jax.Array  # (topk,) int32
+    scores: jax.Array  # (topk,) float32
+    valid: jax.Array   # (topk,) bool — score >= conf_th
+
+
+def peak_mask(heatmap: jax.Array) -> jax.Array:
+    """3x3 max-pool equality peak test (ref transform.py:76-79).
+
+    heatmap: (..., H, W, C) channels-last, any number of leading batch dims.
+    Returns bool mask of local maxima (ties with the 3x3 neighborhood max
+    count as peaks, matching `==`).
+    """
+    lead = heatmap.ndim - 3
+    pooled = jax.lax.reduce_window(
+        heatmap, -jnp.inf, jax.lax.max,
+        window_dimensions=(1,) * lead + (3, 3, 1),
+        window_strides=(1,) * (lead + 3),
+        padding=((0, 0),) * lead + ((1, 1), (1, 1), (0, 0)))
+    return pooled == heatmap
+
+
+@partial(jax.jit, static_argnames=("scale_factor", "topk", "normalized"))
+def decode_heatmap(heatmap: jax.Array, offset: jax.Array, wh: jax.Array,
+                   scale_factor: int = 4, topk: int = 100,
+                   conf_th: float = 0.3, normalized: bool = False) -> Detections:
+    """Decode one image's maps into top-k boxes.
+
+    Args:
+      heatmap: (H, W, C) post-sigmoid class heatmap.
+      offset: (H, W, 2) center offsets (x, y).
+      wh: (H, W, 2) box sizes (w, h).
+      scale_factor: map -> image upsample factor.
+      topk: number of peaks to keep (static).
+      conf_th: confidence threshold, applied as the `valid` mask.
+      normalized: if True, un-normalize offsets (*scale_factor) and sizes
+        (*map width/height) as in the reference.
+
+    Returns a `Detections` with static shapes.
+    """
+    height, width, num_cls = heatmap.shape
+
+    peaks = jnp.where(peak_mask(heatmap), heatmap, 0.0)
+
+    # Flatten class-major (C, H, W) to match the reference's index layout
+    # (class = idx // (H*W)), keeping tie-break ordering identical.
+    flat = peaks.transpose(2, 0, 1).reshape(-1)
+    scores, indices = jax.lax.top_k(flat, topk)
+
+    clss = indices // (height * width)
+    inds = indices % (height * width)
+    yinds = inds // width
+    xinds = inds % width
+
+    xoffs = offset[yinds, xinds, 0]
+    yoffs = offset[yinds, xinds, 1]
+    xsizs = wh[yinds, xinds, 0]
+    ysizs = wh[yinds, xinds, 1]
+
+    if normalized:
+        xoffs = xoffs * scale_factor
+        yoffs = yoffs * scale_factor
+        xsizs = xsizs * width
+        ysizs = ysizs * height
+
+    xf = xinds.astype(jnp.float32) + xoffs
+    yf = yinds.astype(jnp.float32) + yoffs
+    sf = float(scale_factor)
+    boxes = jnp.stack([
+        (xf - xsizs / 2) * sf,
+        (yf - ysizs / 2) * sf,
+        (xf + xsizs / 2) * sf,
+        (yf + ysizs / 2) * sf,
+    ], axis=1)
+
+    valid = scores >= conf_th
+    return Detections(boxes=boxes, classes=clss.astype(jnp.int32),
+                      scores=scores, valid=valid)

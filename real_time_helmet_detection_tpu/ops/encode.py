@@ -1,0 +1,180 @@
+"""Ground-truth encoding: boxes -> (heatmap, offset, size, mask) target maps.
+
+Capability parity with the reference encoder (/root/reference/transform.py:4-70
+`box2hm`, `gaussian2D`, `draw_gaussian`), re-designed for TPU:
+
+* **channels-last** maps `(H, W, C)` — the native TPU conv layout — instead of
+  the reference's `(C, H, W)`;
+* a **vectorized numpy host encoder** (`encode_boxes`) that computes every
+  box's Gaussian in one broadcast instead of the reference's per-box python
+  loop with dynamic-extent window slicing;
+* a **jit-able on-device encoder** (`encode_boxes_jax`) with static
+  `max_boxes` padding so GT encoding can run inside the input pipeline on
+  device — something the CUDA reference cannot do at all.
+
+Semantics preserved exactly (verified by tests/test_encode_decode.py):
+  - center index = floor(box_center / scale_factor)
+  - offset = fractional part of the scaled center; size = scaled box w/h
+  - `normalized=True` divides offsets by `scale_factor` and sizes by the
+    map width/height
+  - Gaussian radius r = distance from center to a box corner at map scale
+    (half-diagonal), sigma = r/3, support window clipped to |dx|,|dy| <= int(r)
+  - overlapping Gaussians of the same class merge with `max`
+  - for coincident centers, the *last* box in the list wins the
+    offset/size/mask scatter (matching in-order assignment)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+def gaussian_radius(xmin: np.ndarray, ymin: np.ndarray, xcen: np.ndarray, ycen: np.ndarray) -> np.ndarray:
+    """Half-diagonal Gaussian radius at map scale (ref transform.py:42)."""
+    return np.sqrt((xcen - xmin) ** 2 + (ycen - ymin) ** 2)
+
+
+def _prepare_boxes(boxes, labels, width, height, scale_factor, normalized):
+    """Shared scalar precomputation. boxes: (N,4) xyxy at image scale."""
+    boxes = np.asarray(boxes, dtype=np.float32).reshape(-1, 4) / float(scale_factor)
+    labels = np.asarray(labels, dtype=np.int32).reshape(-1)
+    xmin, ymin, xmax, ymax = boxes.T
+    xcen, ycen = (xmin + xmax) / 2.0, (ymin + ymax) / 2.0
+    xind = np.clip(np.floor(xcen).astype(np.int32), 0, width - 1)
+    yind = np.clip(np.floor(ycen).astype(np.int32), 0, height - 1)
+    xoff, yoff = xcen - xind, ycen - yind
+    xsize, ysize = xmax - xmin, ymax - ymin
+    if normalized:
+        xoff, yoff = xoff / scale_factor, yoff / scale_factor
+        xsize, ysize = xsize / width, ysize / height
+    radius = gaussian_radius(xmin, ymin, xcen, ycen)
+    return labels, xind, yind, xoff, yoff, xsize, ysize, radius
+
+
+def encode_boxes(boxes, labels, imsize, scale_factor: int = 4, num_cls: int = 2,
+                 normalized: bool = False):
+    """Encode one image's boxes into dense target maps (host-side, numpy).
+
+    Args:
+      boxes: (N, 4) array-like of `xmin, ymin, xmax, ymax` at image scale,
+        or None/empty for a background-only image.
+      labels: (N,) integer class ids in [0, num_cls).
+      imsize: (width, height) of the (augmented) image.
+      scale_factor: image -> map downsample (4, structural — see PreLayer).
+      num_cls: number of classes.
+      normalized: normalize offsets/sizes as in the reference.
+
+    Returns:
+      heatmap (H, W, num_cls), offset (H, W, 2), size (H, W, 2),
+      mask (H, W, 1) — float32, channels-last.
+    """
+    width, height = int(imsize[0]) // scale_factor, int(imsize[1]) // scale_factor
+    heat = np.zeros((height, width, num_cls), dtype=np.float32)
+    offset = np.zeros((height, width, 2), dtype=np.float32)
+    size = np.zeros((height, width, 2), dtype=np.float32)
+    mask = np.zeros((height, width, 1), dtype=np.float32)
+
+    if boxes is None or len(boxes) == 0:
+        return heat, offset, size, mask
+
+    labels, xind, yind, xoff, yoff, xsize, ysize, radius = _prepare_boxes(
+        boxes, labels, width, height, scale_factor, normalized)
+    n = labels.shape[0]
+
+    # Point scatters: in-order so the last coincident box wins.
+    for i in range(n):
+        mask[yind[i], xind[i], 0] = 1.0
+        offset[yind[i], xind[i]] = (xoff[i], yoff[i])
+        size[yind[i], xind[i]] = (xsize[i], ysize[i])
+
+    # Vectorized Gaussian splat: (N, H, W) field, windowed to |d| <= int(r),
+    # then per-class max-reduced.
+    ri = np.floor(radius).astype(np.int32)  # int(r): support half-width
+    ys = np.arange(height, dtype=np.float32)[None, :, None]
+    xs = np.arange(width, dtype=np.float32)[None, None, :]
+    dy = ys - yind[:, None, None].astype(np.float32)
+    dx = xs - xind[:, None, None].astype(np.float32)
+    sigma = np.maximum(radius, 1e-6) / 3.0
+    g = np.exp(-(dx * dx + dy * dy) / (2.0 * sigma * sigma)[:, None, None])
+    window = (np.abs(dx) <= ri[:, None, None]) & (np.abs(dy) <= ri[:, None, None])
+    g = np.where(window, g, 0.0).astype(np.float32)
+    for c in range(num_cls):
+        sel = labels == c
+        if sel.any():
+            heat[:, :, c] = np.max(g[sel], axis=0)
+    return heat, offset, size, mask
+
+
+def encode_boxes_batch(boxes_list, labels_list, imsize, scale_factor: int = 4,
+                       num_cls: int = 2, normalized: bool = False):
+    """Encode a batch (list per image) and stack to (B, H, W, C) arrays."""
+    outs = [encode_boxes(b, l, imsize, scale_factor, num_cls, normalized)
+            for b, l in zip(boxes_list, labels_list)]
+    heat, offset, size, mask = (np.stack(x) for x in zip(*outs))
+    return heat, offset, size, mask
+
+
+@partial(jax.jit, static_argnames=("height", "width", "scale_factor", "num_cls", "normalized"))
+def encode_boxes_jax(boxes: jax.Array, labels: jax.Array, valid: jax.Array, *,
+                     height: int, width: int, scale_factor: int = 4,
+                     num_cls: int = 2, normalized: bool = False):
+    """On-device, jit-able GT encoder with static max_boxes padding.
+
+    Args:
+      boxes: (N, 4) xyxy at image scale (padded rows arbitrary).
+      labels: (N,) int32 class ids.
+      valid: (N,) bool validity of each padded row.
+      height/width: output map size (imsize // scale_factor).
+
+    Returns channels-last maps as in `encode_boxes`. All shapes static.
+    """
+    sf = float(scale_factor)
+    b = boxes.astype(jnp.float32) / sf
+    xmin, ymin, xmax, ymax = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    xcen, ycen = (xmin + xmax) / 2.0, (ymin + ymax) / 2.0
+    xind = jnp.clip(jnp.floor(xcen).astype(jnp.int32), 0, width - 1)
+    yind = jnp.clip(jnp.floor(ycen).astype(jnp.int32), 0, height - 1)
+    xoff, yoff = xcen - xind, ycen - yind
+    xsize, ysize = xmax - xmin, ymax - ymin
+    if normalized:
+        xoff, yoff = xoff / sf, yoff / sf
+        xsize, ysize = xsize / width, ysize / height
+    radius = jnp.sqrt((xcen - xmin) ** 2 + (ycen - ymin) ** 2)
+
+    # Gaussian field (N, H, W), windowed, masked by validity.
+    ri = jnp.floor(radius)
+    ys = jnp.arange(height, dtype=jnp.float32)[None, :, None]
+    xs = jnp.arange(width, dtype=jnp.float32)[None, None, :]
+    dy = ys - yind[:, None, None].astype(jnp.float32)
+    dx = xs - xind[:, None, None].astype(jnp.float32)
+    sigma = jnp.maximum(radius, 1e-6) / 3.0
+    g = jnp.exp(-(dx * dx + dy * dy) / (2.0 * (sigma * sigma))[:, None, None])
+    window = ((jnp.abs(dx) <= ri[:, None, None])
+              & (jnp.abs(dy) <= ri[:, None, None])
+              & valid[:, None, None])
+    g = jnp.where(window, g, 0.0)
+    onehot = jax.nn.one_hot(labels, num_cls, dtype=jnp.float32)  # (N, C)
+    # heat[h, w, c] = max_n g[n, h, w] * onehot[n, c]
+    # initial=0.0 keeps N=0 (background-only, unpadded) well-defined.
+    heat = jnp.max(g[:, :, :, None] * onehot[:, None, None, :], axis=0,
+                   initial=0.0)
+
+    # Last-valid-wins point scatter via a fixed-trip loop (N is static).
+    def body(i, maps):
+        offset, size, mask = maps
+        y, x = yind[i], xind[i]
+        v = valid[i]
+        upd = lambda m, val: jnp.where(v, m.at[y, x].set(val), m)
+        offset = upd(offset, jnp.stack([xoff[i], yoff[i]]))
+        size = upd(size, jnp.stack([xsize[i], ysize[i]]))
+        mask = upd(mask, jnp.ones((1,), jnp.float32))
+        return offset, size, mask
+
+    offset0 = jnp.zeros((height, width, 2), jnp.float32)
+    size0 = jnp.zeros((height, width, 2), jnp.float32)
+    mask0 = jnp.zeros((height, width, 1), jnp.float32)
+    offset, size, mask = jax.lax.fori_loop(0, boxes.shape[0], body, (offset0, size0, mask0))
+    return heat.astype(jnp.float32), offset, size, mask

@@ -1,0 +1,24 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+
+This gives every test (including the multi-chip sharding tests) a fake
+8-device backend — the fake-backend trick the reference lacks entirely
+(SURVEY.md §4).
+
+In this image a sitecustomize imports jax at interpreter startup and
+registers the remote-TPU PJRT plugin, so (a) setting JAX_PLATFORMS via
+os.environ is too late — jax's config already snapshotted it — and
+(b) initializing that backend blocks on the device tunnel. We therefore
+force the platform through `jax.config.update` (which works any time
+before first backend init) and only need XLA_FLAGS in the env because
+the CPU client reads it lazily at its own init.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

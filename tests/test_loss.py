@@ -1,0 +1,129 @@
+"""Loss golden-value tests against an independent numpy reimplementation
+of the reference semantics (/root/reference/loss.py:42-69)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from real_time_helmet_detection_tpu.ops import (
+    focal_loss, normed_l1_loss, detection_loss, LossLog)
+
+
+def _np_focal(pred, gt, mask, alpha=2.0, beta=4.0, eps=1e-7):
+    neg_inds = 1.0 - mask
+    neg_w = (1.0 - gt) ** beta
+    pos = np.log(pred + eps) * (1 - pred) ** alpha * mask
+    neg = np.log(1 - pred + eps) * pred ** alpha * neg_w * neg_inds
+    pos = pos.sum(axis=(1, 2, 3)).mean()
+    neg = neg.sum(axis=(1, 2, 3)).mean()
+    num_pos = np.clip(mask.sum(), 1.0, 1e30)
+    return -(pos + neg) / num_pos
+
+
+def _np_l1(pred, gt, mask):
+    loss = np.abs(pred * mask - gt * mask).sum(axis=(1, 2, 3)).mean()
+    return loss / np.clip(mask.sum(), 1.0, 1e30)
+
+
+def _rand_batch(seed=0, b=3, h=8, w=8, c=2):
+    rng = np.random.RandomState(seed)
+    pred = rng.uniform(0.01, 0.99, (b, h, w, c)).astype(np.float32)
+    gt = rng.uniform(0, 1, (b, h, w, c)).astype(np.float32)
+    mask = (rng.uniform(0, 1, (b, h, w, 1)) > 0.9).astype(np.float32)
+    # make gt exactly 1 at mask positions like real targets
+    gt = np.where(mask > 0, 1.0, gt).astype(np.float32)
+    return pred, gt, mask
+
+
+def test_focal_matches_numpy_reference():
+    pred, gt, mask = _rand_batch()
+    got = float(focal_loss(jnp.asarray(pred), jnp.asarray(gt), jnp.asarray(mask)))
+    want = _np_focal(pred, gt, mask)
+    assert got == pytest.approx(want, rel=1e-5)
+
+
+def test_focal_no_positives_clamps_to_one():
+    pred, gt, _ = _rand_batch()
+    mask = np.zeros((3, 8, 8, 1), np.float32)
+    got = float(focal_loss(jnp.asarray(pred), jnp.asarray(gt), jnp.asarray(mask)))
+    want = _np_focal(pred, gt, mask)
+    assert got == pytest.approx(want, rel=1e-5)
+    assert np.isfinite(got)
+
+
+def test_focal_perfect_prediction_near_zero():
+    # Single class: with multiple classes, the (B,H,W,1) mask broadcasts over
+    # the class axis (the reference's (B,1,H,W) mask does the same), so a
+    # positive center penalizes every class channel — tested separately below.
+    gt = np.zeros((1, 8, 8, 1), np.float32)
+    mask = np.zeros((1, 8, 8, 1), np.float32)
+    gt[0, 4, 4, 0] = 1.0
+    mask[0, 4, 4, 0] = 1.0
+    pred = np.clip(gt, 1e-4, 1 - 1e-4)
+    loss = float(focal_loss(jnp.asarray(pred), jnp.asarray(gt), jnp.asarray(mask)))
+    assert loss < 1e-3
+
+
+def test_focal_mask_broadcasts_over_classes_like_reference():
+    # A positive center masks *all* class channels positive (reference quirk:
+    # loss.py:63 multiplies by the 1-channel mask, broadcasting over classes).
+    gt = np.zeros((1, 8, 8, 2), np.float32)
+    mask = np.zeros((1, 8, 8, 1), np.float32)
+    gt[0, 4, 4, 0] = 1.0
+    mask[0, 4, 4, 0] = 1.0
+    pred = np.clip(gt, 1e-4, 1 - 1e-4)
+    loss = float(focal_loss(jnp.asarray(pred), jnp.asarray(gt), jnp.asarray(mask)))
+    want = _np_focal(pred, gt, mask)
+    assert loss == pytest.approx(want, rel=1e-5)
+    assert loss > 1.0  # the off-class channel at the center is penalized
+
+
+def test_l1_matches_numpy_reference():
+    rng = np.random.RandomState(1)
+    pred = rng.randn(2, 8, 8, 2).astype(np.float32)
+    gt = rng.randn(2, 8, 8, 2).astype(np.float32)
+    mask = (rng.uniform(0, 1, (2, 8, 8, 1)) > 0.8).astype(np.float32)
+    got = float(normed_l1_loss(jnp.asarray(pred), jnp.asarray(gt), jnp.asarray(mask)))
+    assert got == pytest.approx(_np_l1(pred, gt, mask), rel=1e-5)
+
+
+def test_l1_golden_single_position():
+    # One positive at (0,0); pred-gt = (0.5, -1.5) there -> sum=2.0;
+    # batch mean over 1 sample / num_pos(=1) = 2.0
+    pred = np.zeros((1, 4, 4, 2), np.float32)
+    gt = np.zeros((1, 4, 4, 2), np.float32)
+    mask = np.zeros((1, 4, 4, 1), np.float32)
+    mask[0, 0, 0, 0] = 1.0
+    pred[0, 0, 0] = [0.5, 1.5]
+    gt[0, 0, 0] = [0.0, 3.0]
+    got = float(normed_l1_loss(jnp.asarray(pred), jnp.asarray(gt), jnp.asarray(mask)))
+    assert got == pytest.approx(2.0)
+
+
+def test_detection_loss_weighting():
+    pred, gt, mask = _rand_batch(seed=2)
+    off = np.random.RandomState(3).randn(3, 8, 8, 2).astype(np.float32)
+    goff = np.zeros_like(off)
+    losses = detection_loss(jnp.asarray(pred), jnp.asarray(off), jnp.asarray(off),
+                            jnp.asarray(gt), jnp.asarray(goff), jnp.asarray(goff),
+                            jnp.asarray(mask), hm_weight=1.0, offset_weight=1.0,
+                            size_weight=0.1)
+    total = float(losses["hm"]) + float(losses["offset"]) + 0.1 * float(losses["size"])
+    assert float(losses["total"]) == pytest.approx(total, rel=1e-6)
+
+
+def test_loss_is_differentiable_and_finite():
+    pred, gt, mask = _rand_batch(seed=4)
+    g = jax.grad(lambda p: focal_loss(p, jnp.asarray(gt), jnp.asarray(mask)))(
+        jnp.asarray(pred))
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_loss_log_running_mean():
+    log = LossLog()
+    for i in range(5):
+        log.append({"hm": i, "offset": 0.0, "size": 0.0, "total": float(i)})
+    s = log.get_log(length=2)
+    assert "hm:  3.50" in s
+    assert log.state_dict()["total"] == [0.0, 1.0, 2.0, 3.0, 4.0]

@@ -1,0 +1,90 @@
+"""NMS tests: greedy hard NMS vs a trivial O(N^2) numpy oracle, soft-NMS
+decay semantics, and masked fixed-shape behavior."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from real_time_helmet_detection_tpu.ops import nms_mask, soft_nms_mask
+
+
+def _np_greedy_nms(boxes, scores, iou_th):
+    """Oracle with torchvision semantics (no +1, suppress iou > th)."""
+    idx = np.argsort(-scores, kind="stable")
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for i in idx:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        x1, y1, x2, y2 = boxes[i]
+        for j in idx:
+            if suppressed[j] or j == i:
+                continue
+            ax1, ay1 = max(x1, boxes[j][0]), max(y1, boxes[j][1])
+            ax2, ay2 = min(x2, boxes[j][2]), min(y2, boxes[j][3])
+            inter = max(0, ax2 - ax1) * max(0, ay2 - ay1)
+            a = (x2 - x1) * (y2 - y1)
+            b = (boxes[j][2] - boxes[j][0]) * (boxes[j][3] - boxes[j][1])
+            if inter / (a + b - inter) > iou_th:
+                suppressed[j] = True
+    return sorted(keep)
+
+
+def test_nms_matches_oracle_random():
+    rng = np.random.RandomState(0)
+    for seed in range(5):
+        rng = np.random.RandomState(seed)
+        n = 32
+        xy = rng.uniform(0, 100, (n, 2))
+        wh = rng.uniform(5, 40, (n, 2))
+        boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        scores = rng.uniform(0.1, 1.0, n).astype(np.float32)
+        valid = np.ones(n, bool)
+        keep = np.asarray(nms_mask(jnp.asarray(boxes), jnp.asarray(scores),
+                                   jnp.asarray(valid), 0.5))
+        assert sorted(np.nonzero(keep)[0].tolist()) == _np_greedy_nms(boxes, scores, 0.5)
+
+
+def test_nms_identical_boxes_keep_best():
+    boxes = jnp.asarray([[0, 0, 10, 10]] * 3, jnp.float32)
+    scores = jnp.asarray([0.5, 0.9, 0.7])
+    keep = nms_mask(boxes, scores, jnp.ones(3, bool), 0.5)
+    assert np.asarray(keep).tolist() == [False, True, False]
+
+
+def test_nms_disjoint_boxes_all_kept():
+    boxes = jnp.asarray([[0, 0, 10, 10], [20, 20, 30, 30], [50, 0, 60, 10]],
+                        jnp.float32)
+    keep = nms_mask(boxes, jnp.asarray([0.9, 0.8, 0.7]), jnp.ones(3, bool), 0.5)
+    assert np.asarray(keep).all()
+
+
+def test_nms_invalid_never_kept_never_suppress():
+    # High-scoring invalid box overlaps a valid one: valid must survive.
+    boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11]], jnp.float32)
+    scores = jnp.asarray([0.99, 0.5])
+    valid = jnp.asarray([False, True])
+    keep = np.asarray(nms_mask(boxes, scores, valid, 0.5))
+    assert keep.tolist() == [False, True]
+
+
+def test_soft_nms_decays_overlapping():
+    boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                        jnp.float32)
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    keep, new_scores = soft_nms_mask(boxes, scores, jnp.ones(3, bool),
+                                     sigma=0.5, score_th=0.001)
+    new_scores = np.asarray(new_scores)
+    assert new_scores[0] == pytest.approx(0.9)       # top box untouched
+    assert new_scores[1] < 0.8                        # overlapped: decayed
+    assert new_scores[2] == pytest.approx(0.7, abs=1e-4)  # far box ~untouched
+    assert np.asarray(keep).all()                     # all above 0.001
+
+
+def test_soft_nms_kills_duplicates():
+    boxes = jnp.asarray([[0, 0, 100, 100]] * 2, jnp.float32)
+    scores = jnp.asarray([0.9, 0.85])
+    keep, new_scores = soft_nms_mask(boxes, scores, jnp.ones(2, bool),
+                                     sigma=0.5, score_th=0.2)
+    assert np.asarray(keep).tolist() == [True, False]
