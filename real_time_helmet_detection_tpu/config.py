@@ -1,0 +1,208 @@
+"""Config / flag system for the TPU framework.
+
+Capability parity with the reference CLI (/root/reference/config.py:11-136:
+~45 argparse flags over device, train, precision, distributed, eval/demo,
+augmentation, loss, network, optimizer, logging), re-designed TPU-first:
+
+* a typed `Config` dataclass is the single source of truth; the argparse
+  parser is generated from its fields, so every flag exists exactly once;
+* snapshots are human-readable `argument.txt` plus **JSON** `argument.json`
+  (the reference pickles the whole namespace, config.py:168 — JSON is
+  portable and safe to load);
+* eval mode overrides the architecture fields from the checkpoint dir's
+  snapshot so a CLI mistake can't build a mismatched network
+  (ref config.py:157-158, 171-179);
+* GPU-only knobs are re-interpreted for TPU: `--amp` selects the bf16
+  compute policy (no GradScaler exists on TPU), `--dist-backend` is
+  accepted for CLI compatibility but the backend is always XLA collectives,
+  and `--num-devices` replaces `--gpu-no` (device *count* on the mesh,
+  not CUDA ids).
+
+Dead reference flags are kept for CLI compatibility and documented as such:
+`--pool-size` (never read by the reference either, ref config.py:58),
+`--optim` (reference hard-codes Adam, ref optim.py:4 — here it actually
+selects the optax optimizer, an upgrade).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import random
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+# The architecture fields restored from a checkpoint's snapshot at eval time
+# (ref config.py:171-179's `targets` list).
+ARCHITECTURE_FIELDS = (
+    "scale_factor", "num_cls", "pretrained", "normalized_coord",
+    "num_stack", "hourglass_inch", "increase_ch", "activation", "pool",
+    "neck_activation", "neck_pool",
+)
+
+
+@dataclass
+class Config:
+    """All flags. Field name -> CLI flag: underscores become dashes."""
+
+    # device
+    num_devices: int = 0          # 0 = use every visible device
+    random_seed: int = 777
+
+    # train
+    train_flag: bool = False
+    data: Optional[str] = None
+    batch_size: int = 16
+    sub_divisions: int = 1        # gradient accumulation (ref train.py:124)
+    start_epoch: int = 0
+    end_epoch: int = 100
+    num_workers: int = 8          # host-side data pipeline threads
+
+    # precision (TPU: bf16 policy replaces CUDA AMP + GradScaler)
+    amp: bool = False
+
+    # distributed (multi-host over DCN; in-host over ICI mesh)
+    world_size: int = 1           # number of hosts
+    rank: int = 0                 # this host's index
+    dist_backend: str = "xla"     # accepted for CLI parity; always XLA
+    dist_url: str = "tcp://localhost:29500"  # jax.distributed coordinator
+
+    # evaluation and demo
+    imsize: Optional[int] = None
+    topk: int = 100
+    conf_th: float = 0.0
+    nms_th: float = 0.5
+    pool_size: int = 3            # peak-test window (3x3, as the reference)
+    model_load: Optional[str] = None
+    nms: str = "nms"              # nms | soft-nms
+    fontsize: int = 10
+
+    # augmentation
+    crop_percent: List[float] = field(default_factory=lambda: [0.0, 0.1])
+    color_multiply: List[float] = field(default_factory=lambda: [1.2, 1.5])
+    translate_percent: float = 0.1
+    affine_scale: List[float] = field(default_factory=lambda: [0.5, 1.5])
+    multiscale_flag: bool = False
+    multiscale: List[int] = field(default_factory=lambda: [320, 512, 64])
+
+    # loss
+    hm_weight: float = 1.0
+    offset_weight: float = 1.0
+    size_weight: float = 0.1
+    focal_alpha: float = 2.0
+    focal_beta: float = 4.0
+
+    # network
+    scale_factor: int = 4
+    num_cls: int = 2
+    pretrained: str = "imagenet"  # selects normalization stats only (as ref)
+    normalized_coord: bool = False
+    num_stack: int = 1
+    hourglass_inch: int = 128
+    increase_ch: int = 0
+    activation: str = "ReLU"
+    pool: str = "Max"
+    neck_activation: str = "ReLU"
+    neck_pool: str = "None"
+
+    # optimization
+    lr: float = 5e-4
+    optim: str = "Adam"
+    lr_milestone: List[int] = field(default_factory=lambda: [50, 90])
+    lr_gamma: float = 0.1
+
+    # data-pipeline limits (TPU static shapes; no reference analogue)
+    max_boxes: int = 128          # per-image GT padding for encode
+
+    # log
+    print_interval: int = 100
+    save_path: str = "./WEIGHTS/"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """Generate the argparse parser from `Config`'s fields."""
+    parser = argparse.ArgumentParser(
+        description="TPU-native real-time helmet detection framework")
+    for f in dataclasses.fields(Config):
+        flag = "--" + f.name.replace("_", "-")
+        default = (f.default_factory() if f.default_factory is not dataclasses.MISSING
+                   else f.default)
+        if f.type in ("bool", bool):
+            parser.add_argument(flag, action="store_true", default=default)
+        elif isinstance(default, list):
+            elem = type(default[0]) if default else str
+            parser.add_argument(flag, type=elem, nargs="+", default=default)
+        elif f.type in ("Optional[int]",):
+            parser.add_argument(flag, type=int, default=default)
+        elif f.type in ("Optional[str]",):
+            parser.add_argument(flag, type=str, default=default)
+        else:
+            parser.add_argument(flag, type=type(default), default=default)
+    # reference-compat aliases
+    parser.add_argument("--multiscale_flag", dest="multiscale_flag",
+                        action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--scale_factor", dest="scale_factor", type=int,
+                        help=argparse.SUPPRESS)
+    return parser
+
+
+def parse_args(argv=None) -> Config:
+    ns = build_parser().parse_args(argv)
+    d = vars(ns)
+    return Config(**{f.name: d[f.name] for f in dataclasses.fields(Config)})
+
+
+def seed_everything(seed: int) -> None:
+    """Global seeding (ref config.py:143-147). JAX RNG is explicit
+    (jax.random.key), threaded through the train/data code; host-side
+    python/numpy randomness (augmentation sampling) is seeded here."""
+    random.seed(seed)
+    np.random.seed(seed)
+
+
+def save_config(cfg: Config, save_path: str) -> None:
+    """Persist `argument.txt` + `argument.json` (ref config.py:164-168)."""
+    os.makedirs(save_path, exist_ok=True)
+    d = dataclasses.asdict(cfg)
+    with open(os.path.join(save_path, "argument.txt"), "w") as f:
+        for key, value in sorted(d.items()):
+            f.write("%s: %s\n" % (key, value))
+    with open(os.path.join(save_path, "argument.json"), "w") as f:
+        json.dump(d, f, indent=2, sort_keys=True)
+
+
+def load_config(path: str) -> Config:
+    """Load a JSON snapshot back into a Config (unknown keys ignored)."""
+    with open(path) as f:
+        d = json.load(f)
+    names = {f.name for f in dataclasses.fields(Config)}
+    return Config(**{k: v for k, v in d.items() if k in names})
+
+
+def update_config_for_eval(cfg: Config, loaded: Config) -> Config:
+    """Override the architecture fields from the training-time snapshot
+    (ref config.py:171-179)."""
+    return dataclasses.replace(
+        cfg, **{k: getattr(loaded, k) for k in ARCHITECTURE_FIELDS})
+
+
+def get_config(argv=None) -> Config:
+    """Full CLI entry (ref config.py:139-169): parse, seed, snapshot dirs,
+    eval-time architecture restore."""
+    cfg = parse_args(argv)
+    seed_everything(cfg.random_seed)
+
+    os.makedirs(cfg.save_path, exist_ok=True)
+    if cfg.train_flag:
+        os.makedirs(os.path.join(cfg.save_path, "training_log"), exist_ok=True)
+    elif cfg.model_load:
+        snap = os.path.join(os.path.dirname(cfg.model_load), "argument.json")
+        if os.path.exists(snap):
+            cfg = update_config_for_eval(cfg, load_config(snap))
+
+    save_config(cfg, cfg.save_path)
+    return cfg

@@ -1,0 +1,13 @@
+"""Data layer: VOC parsing, augmentation, batching, synthetic fixtures."""
+
+from .voc import CLASS2COLOR, CLASS2INDEX, INDEX2CLASS, VOCDataset
+from .augment import TestAugmentor, TrainAugmentor
+from .pipeline import Batch, BatchLoader, collate, load_dataset
+from .synthetic import make_synthetic_voc
+
+__all__ = [
+    "CLASS2COLOR", "CLASS2INDEX", "INDEX2CLASS", "VOCDataset",
+    "TestAugmentor", "TrainAugmentor",
+    "Batch", "BatchLoader", "collate", "load_dataset",
+    "make_synthetic_voc",
+]

@@ -1,0 +1,171 @@
+"""Augmentation with joint image/box transforms — no imgaug.
+
+Capability parity with the reference augmentors (/root/reference/data.py:127-170
+`TrainAugmentor`, `TestAugmentor`): color multiply, affine
+(translate + scale about the image center), crop-and-keep-size, horizontal
+flip, out-of-image box removal + clipping, and per-batch multiscale resize
+drawn from `range(min, max, step)` (ref data.py:153-159 — the max endpoint is
+*excluded*, matching python `range`).
+
+Re-designed rather than translated: the whole geometric chain
+(affine ∘ crop ∘ flip ∘ resize) composes into a **single 3x3 matrix** per
+image, applied once to the pixels (one resampling pass instead of imgaug's
+four) and exactly to the boxes (corner transform -> axis-aligned envelope,
+the same envelope semantics imgaug uses). This keeps the host input pipeline
+cheap — the classic input-bound risk for short TPU steps (SURVEY.md §3.1).
+
+All randomness flows through an explicit `np.random.Generator`, so the
+pipeline is reproducible and per-epoch reseedable (the `set_epoch`
+equivalent, ref train.py:67).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+from PIL import Image
+
+
+def _translation(tx: float, ty: float) -> np.ndarray:
+    m = np.eye(3, dtype=np.float64)
+    m[0, 2], m[1, 2] = tx, ty
+    return m
+
+
+def _scaling(sx: float, sy: float) -> np.ndarray:
+    return np.diag([sx, sy, 1.0]).astype(np.float64)
+
+
+def transform_boxes(boxes: np.ndarray, m: np.ndarray) -> np.ndarray:
+    """Map (N, 4) xyxy boxes through a 3x3 matrix; axis-aligned envelope of
+    the 4 transformed corners (imgaug's box semantics)."""
+    if len(boxes) == 0:
+        return boxes.reshape(0, 4).astype(np.float32)
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    corners = np.stack([
+        np.stack([x1, y1], -1), np.stack([x2, y1], -1),
+        np.stack([x2, y2], -1), np.stack([x1, y2], -1),
+    ], axis=1)  # (N, 4, 2)
+    ones = np.ones((*corners.shape[:2], 1))
+    pts = np.concatenate([corners, ones], axis=-1) @ m.T  # (N, 4, 3)
+    xy = pts[..., :2] / pts[..., 2:3]
+    return np.concatenate([xy.min(axis=1), xy.max(axis=1)], axis=-1).astype(np.float32)
+
+
+def apply_affine_image(img: np.ndarray, m: np.ndarray,
+                       out_size: Tuple[int, int]) -> np.ndarray:
+    """Warp an (H, W, 3) uint8 image by forward matrix `m` into
+    (out_h, out_w). PIL's AFFINE takes the inverse (output->input) map."""
+    inv = np.linalg.inv(m)
+    coeffs = (inv[0, 0], inv[0, 1], inv[0, 2], inv[1, 0], inv[1, 1], inv[1, 2])
+    out_w, out_h = int(out_size[0]), int(out_size[1])
+    pil = Image.fromarray(img).transform((out_w, out_h), Image.AFFINE, coeffs,
+                                         resample=Image.BILINEAR)
+    return np.asarray(pil)
+
+
+def filter_boxes(boxes: np.ndarray, labels: np.ndarray,
+                 size: Tuple[int, int]) -> Tuple[np.ndarray, np.ndarray]:
+    """Drop boxes fully outside the (w, h) canvas, clip the rest
+    (ref data.py:151 `remove_out_of_image().clip_out_of_image()`)."""
+    if len(boxes) == 0:
+        return boxes, labels
+    w, h = size
+    keep = ((boxes[:, 2] > 0) & (boxes[:, 0] < w)
+            & (boxes[:, 3] > 0) & (boxes[:, 1] < h))
+    boxes, labels = boxes[keep], labels[keep]
+    boxes = boxes.copy()
+    boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, w)
+    boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, h)
+    # clipping can collapse a box to zero extent; drop those too
+    keep = (boxes[:, 2] > boxes[:, 0]) & (boxes[:, 3] > boxes[:, 1])
+    return boxes[keep], labels[keep]
+
+
+class TrainAugmentor:
+    """Batch-level training augmentation (ref data.py:127-161).
+
+    Per image: color multiply, centered affine (scale + translate), random
+    per-side crop (crop-and-keep-size, like `iaa.Crop`), horizontal flip
+    p=0.5 — fused with the final square resize into one warp. The target
+    size is sampled **once per batch** from the multiscale grid.
+    """
+
+    def __init__(self, crop_percent=(0.0, 0.1), color_multiply=(1.2, 1.5),
+                 translate_percent: float = 0.1, affine_scale=(0.5, 1.5),
+                 multiscale_flag: bool = False,
+                 multiscale: Sequence[int] = (320, 512, 64),
+                 rng: Optional[np.random.Generator] = None):
+        self.crop_percent = tuple(crop_percent)
+        self.color_multiply = tuple(color_multiply)
+        self.translate_percent = translate_percent
+        self.affine_scale = tuple(affine_scale)
+        self.multiscale_flag = multiscale_flag
+        self.sizes = list(range(multiscale[0], multiscale[1], multiscale[2]))
+        self.max_size = multiscale[1]
+        self.rng = rng or np.random.default_rng()
+
+    def sample_size(self) -> int:
+        if self.multiscale_flag:
+            return int(self.rng.choice(self.sizes))
+        return int(self.max_size)
+
+    def _sample_matrix(self, w: int, h: int, target: int) -> np.ndarray:
+        rng = self.rng
+        # centered affine: scale about center + translate by image fraction
+        s = rng.uniform(*self.affine_scale)
+        tx = rng.uniform(-self.translate_percent, self.translate_percent) * w
+        ty = rng.uniform(-self.translate_percent, self.translate_percent) * h
+        affine = (_translation(w / 2 + tx, h / 2 + ty)
+                  @ _scaling(s, s)
+                  @ _translation(-w / 2, -h / 2))
+        # crop-and-keep-size: per-side fractions, then zoom back to (w, h)
+        lo, hi = self.crop_percent
+        top, right, bottom, left = (rng.uniform(lo, hi) for _ in range(4))
+        cw = max(w * (1.0 - left - right), 1.0)
+        ch = max(h * (1.0 - top - bottom), 1.0)
+        crop = _scaling(w / cw, h / ch) @ _translation(-left * w, -top * h)
+        m = crop @ affine
+        # horizontal flip p=0.5
+        if rng.random() < 0.5:
+            m = (_translation(w, 0.0) @ _scaling(-1.0, 1.0)) @ m
+        # final square resize to (target, target)
+        return _scaling(target / w, target / h) @ m
+
+    def __call__(self, images: List[np.ndarray], boxes: List[np.ndarray],
+                 labels: List[np.ndarray]):
+        target = self.sample_size()
+        out_imgs, out_boxes, out_labels = [], [], []
+        for img, bxs, lbs in zip(images, boxes, labels):
+            h, w = img.shape[:2]
+            mult = self.rng.uniform(*self.color_multiply)
+            img = np.clip(img.astype(np.float32) * mult, 0, 255).astype(np.uint8)
+            m = self._sample_matrix(w, h, target)
+            out_imgs.append(apply_affine_image(img, m, (target, target)))
+            bxs = transform_boxes(bxs, m)
+            bxs, lbs = filter_boxes(bxs, lbs, (target, target))
+            out_boxes.append(bxs)
+            out_labels.append(lbs)
+        return out_imgs, out_boxes, out_labels
+
+
+class TestAugmentor:
+    """Deterministic square resize (ref data.py:163-170)."""
+
+    __test__ = False  # not a pytest class despite the name
+
+    def __init__(self, imsize: int):
+        self.imsize = int(imsize)
+
+    def __call__(self, images: List[np.ndarray], boxes: List[np.ndarray],
+                 labels: List[np.ndarray]):
+        t = self.imsize
+        out_imgs, out_boxes = [], []
+        for img, bxs in zip(images, boxes):
+            h, w = img.shape[:2]
+            m = _scaling(t / w, t / h)
+            pil = Image.fromarray(img).resize((t, t), Image.BILINEAR)
+            out_imgs.append(np.asarray(pil))
+            out_boxes.append(transform_boxes(bxs, m))
+        return out_imgs, out_boxes, list(labels)

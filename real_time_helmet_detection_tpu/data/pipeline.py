@@ -1,0 +1,198 @@
+"""Input pipeline: collation, sharding, shuffling, prefetch.
+
+Capability parity with the reference's DataLoader stack:
+  * `collate` = the reference's `VOC.collate_fn` (/root/reference/data.py:93-125):
+    batch-level augmentation, per-image GT encoding at one shared post-resize
+    size (ref data.py:112 uses the first image's shape for the whole batch —
+    here the augmentor returns the shared size explicitly), normalization and
+    stacking;
+  * `BatchLoader` = `torch.utils.data.DataLoader` + `DistributedSampler`
+    (ref train.py:54-55): per-host sharding by (rank, world_size), per-epoch
+    reshuffle keyed on (seed, epoch) (= `sampler.set_epoch`, ref train.py:67),
+    worker threads for decode/augment overlap, and an iterator-level prefetch
+    queue.
+
+TPU-first: batches are channels-last numpy, padded GT box arrays
+(`max_boxes` static) ride along so the on-device `encode_boxes_jax` path can
+be used instead of host encoding; drop_last semantics keep the global batch
+shape static across steps (XLA recompile avoidance).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..ops.encode import encode_boxes
+from ..utils import normalize_image
+
+
+@dataclass
+class Batch:
+    """One training/eval batch, channels-last numpy."""
+    image: np.ndarray     # (B, S, S, 3) float32 normalized
+    heatmap: np.ndarray   # (B, S/4, S/4, num_cls)
+    offset: np.ndarray    # (B, S/4, S/4, 2)
+    wh: np.ndarray        # (B, S/4, S/4, 2)
+    mask: np.ndarray      # (B, S/4, S/4, 1)
+    boxes: np.ndarray     # (B, max_boxes, 4) padded xyxy at augmented scale
+    labels: np.ndarray    # (B, max_boxes) int32
+    valid: np.ndarray     # (B, max_boxes) bool
+    infos: List[dict]     # per-image voc dicts (eval needs origin size)
+
+
+def pad_boxes(boxes: np.ndarray, labels: np.ndarray, max_boxes: int):
+    n = min(len(boxes), max_boxes)
+    b = np.zeros((max_boxes, 4), np.float32)
+    l = np.zeros((max_boxes,), np.int32)
+    v = np.zeros((max_boxes,), bool)
+    b[:n], l[:n], v[:n] = boxes[:n], labels[:n], True
+    return b, l, v
+
+
+def collate(samples: Sequence, augmentor, pretrained: str = "imagenet",
+            num_cls: int = 2, normalized_coord: bool = False,
+            scale_factor: int = 4, max_boxes: int = 128) -> Batch:
+    """samples: list of (img, boxes, labels, voc_dict) from `VOCDataset`."""
+    imgs, boxes, labels, infos = zip(*samples)
+    imgs, boxes, labels = augmentor(list(imgs), list(boxes), list(labels))
+
+    size = imgs[0].shape[0]  # square; shared across the batch
+    heat, off, wh, mask, pb, pl, pv = [], [], [], [], [], [], []
+    for b, l in zip(boxes, labels):
+        h, o, w, m = encode_boxes(b, l, (size, size), scale_factor, num_cls,
+                                  normalized_coord)
+        heat.append(h); off.append(o); wh.append(w); mask.append(m)
+        bb, ll, vv = pad_boxes(b, l, max_boxes)
+        pb.append(bb); pl.append(ll); pv.append(vv)
+
+    image = np.stack([normalize_image(im, pretrained) for im in imgs])
+    return Batch(image=image.astype(np.float32),
+                 heatmap=np.stack(heat), offset=np.stack(off),
+                 wh=np.stack(wh), mask=np.stack(mask),
+                 boxes=np.stack(pb), labels=np.stack(pl), valid=np.stack(pv),
+                 infos=list(infos))
+
+
+class BatchLoader:
+    """Sharded, shuffled, prefetching batch iterator.
+
+    The per-host shard is `indices[rank::world_size]` after a (seed, epoch)
+    keyed permutation — the `DistributedSampler` equivalent (ref
+    train.py:54, 67). `drop_last=True` for training keeps shapes static.
+    """
+
+    def __init__(self, dataset, augmentor, batch_size: int,
+                 pretrained: str = "imagenet", num_cls: int = 2,
+                 normalized_coord: bool = False, scale_factor: int = 4,
+                 max_boxes: int = 128, shuffle: bool = True,
+                 drop_last: bool = True, rank: int = 0, world_size: int = 1,
+                 seed: int = 777, num_workers: int = 4, prefetch: int = 2):
+        self.dataset = dataset
+        self.augmentor = augmentor
+        self.batch_size = batch_size
+        self.kw = dict(pretrained=pretrained, num_cls=num_cls,
+                       normalized_coord=normalized_coord,
+                       scale_factor=scale_factor, max_boxes=max_boxes)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.rank, self.world_size = rank, world_size
+        self.seed = seed
+        self.epoch = 0
+        self.num_workers = max(1, num_workers)
+        self.prefetch = prefetch
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _indices(self) -> np.ndarray:
+        idx = np.arange(len(self.dataset))
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            idx = rng.permutation(idx)
+        # Pad by wrapping so every host gets the same number of samples —
+        # required for SPMD lockstep (every host must issue the same number
+        # of collectives per epoch); same policy as DistributedSampler.
+        total = -(-len(idx) // self.world_size) * self.world_size
+        if total > len(idx) and len(idx) > 0:
+            idx = np.concatenate([idx, idx[:total - len(idx)]])
+        return idx[self.rank::self.world_size]
+
+    def __len__(self) -> int:
+        n = len(self._indices())
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def _make_batch(self, pool: ThreadPoolExecutor, idx_chunk) -> Batch:
+        samples = list(pool.map(self.dataset.__getitem__, idx_chunk))
+        return collate(samples, self.augmentor, **self.kw)
+
+    def __iter__(self) -> Iterator[Batch]:
+        idx = self._indices()
+        nb = len(self)
+        chunks = [idx[i * self.batch_size:(i + 1) * self.batch_size]
+                  for i in range(nb)]
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # Blocking put would deadlock a producer whose consumer already
+            # left; poll with a timeout so `stop` is always observed.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                with ThreadPoolExecutor(self.num_workers) as pool:
+                    for chunk in chunks:
+                        if stop.is_set():
+                            return
+                        if not put(self._make_batch(pool, chunk)):
+                            return
+                put(None)
+            except BaseException as e:  # surface decode/augment failures
+                put(e)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+
+def load_dataset(cfg, rng: Optional[np.random.Generator] = None):
+    """Build (dataset, augmentor) from config (ref data.py:172-189)."""
+    from .voc import VOCDataset
+    from .augment import TestAugmentor, TrainAugmentor
+
+    if cfg.train_flag:
+        augmentor = TrainAugmentor(
+            crop_percent=tuple(cfg.crop_percent),
+            color_multiply=tuple(cfg.color_multiply),
+            translate_percent=cfg.translate_percent,
+            affine_scale=tuple(cfg.affine_scale),
+            multiscale_flag=cfg.multiscale_flag,
+            multiscale=cfg.multiscale,
+            rng=rng or np.random.default_rng(cfg.random_seed))
+        image_set = "trainval"
+    else:
+        augmentor = TestAugmentor(imsize=cfg.imsize)
+        image_set = "test"
+    dataset = VOCDataset(cfg.data, image_set=image_set)
+    return dataset, augmentor

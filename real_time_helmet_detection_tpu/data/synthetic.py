@@ -1,0 +1,80 @@
+"""Synthetic SHWD-style fixture dataset generator.
+
+The reference has no test fixtures at all (SURVEY.md §4); this generator
+writes a miniature VOC2028-layout dataset (JPEGImages / Annotations /
+ImageSets/Main) with rendered rectangles as "hat"/"person" objects, so the
+full train->eval->mAP loop is testable hermetically (SURVEY.md §4 invariant
+(6): end-to-end mAP on a tiny fixture dataset) and benchmarkable without
+the real SHWD download.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+from PIL import Image, ImageDraw
+
+from .voc import INDEX2CLASS
+
+_XML = """<annotation>
+  <folder>VOC2028</folder>
+  <filename>{fname}.jpg</filename>
+  <size><width>{w}</width><height>{h}</height><depth>3</depth></size>
+  <segmented>0</segmented>
+{objects}</annotation>
+"""
+
+_OBJ = """  <object>
+    <name>{name}</name>
+    <pose>Unspecified</pose>
+    <truncated>0</truncated>
+    <difficult>0</difficult>
+    <bndbox><xmin>{x1}</xmin><ymin>{y1}</ymin><xmax>{x2}</xmax><ymax>{y2}</ymax></bndbox>
+  </object>
+"""
+
+
+def make_synthetic_voc(root: str, num_train: int = 8, num_test: int = 4,
+                       imsize: Tuple[int, int] = (160, 120),
+                       max_objects: int = 3, seed: int = 0) -> str:
+    """Write a synthetic VOC2028-layout dataset under `root`; returns root."""
+    rng = np.random.default_rng(seed)
+    img_dir = os.path.join(root, "JPEGImages")
+    ann_dir = os.path.join(root, "Annotations")
+    set_dir = os.path.join(root, "ImageSets", "Main")
+    for d in (img_dir, ann_dir, set_dir):
+        os.makedirs(d, exist_ok=True)
+
+    splits = {"trainval": num_train, "test": num_test}
+    counter = 0
+    for split, n in splits.items():
+        names = []
+        for _ in range(n):
+            fname = "%06d" % counter
+            counter += 1
+            names.append(fname)
+            w, h = imsize
+            img = Image.fromarray(
+                rng.integers(0, 80, (h, w, 3), dtype=np.uint8))
+            draw = ImageDraw.Draw(img)
+            objects = []
+            for _ in range(int(rng.integers(1, max_objects + 1))):
+                cls = int(rng.integers(0, 2))
+                bw = int(rng.integers(w // 8, w // 3))
+                bh = int(rng.integers(h // 8, h // 3))
+                x1 = int(rng.integers(0, w - bw))
+                y1 = int(rng.integers(0, h - bh))
+                x2, y2 = x1 + bw, y1 + bh
+                color = (220, 40, 40) if cls == 0 else (40, 220, 40)
+                draw.rectangle([x1, y1, x2, y2], fill=color)
+                objects.append(_OBJ.format(name=INDEX2CLASS[cls], x1=x1, y1=y1,
+                                           x2=x2, y2=y2))
+            img.save(os.path.join(img_dir, fname + ".jpg"), quality=90)
+            with open(os.path.join(ann_dir, fname + ".xml"), "w") as f:
+                f.write(_XML.format(fname=fname, w=w, h=h,
+                                    objects="".join(objects)))
+        with open(os.path.join(set_dir, split + ".txt"), "w") as f:
+            f.write("\n".join(names) + "\n")
+    return root

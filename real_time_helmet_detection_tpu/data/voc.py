@@ -1,0 +1,100 @@
+"""VOC2028 / SHWD dataset parsing.
+
+Capability parity with the reference dataset (/root/reference/data.py:22-91
+`VOC`): same directory layout (`JPEGImages`, `Annotations`,
+`ImageSets/Main/{trainval,test}.txt`), same recursive XML->dict parser, same
+class map `{'hat': 0, 'person': 1, 'dog': 0}` (SHWD's mislabeled `dog` boxes
+folded into class 0, ref data.py:17).
+
+TPU-first differences: `__getitem__` returns plain numpy — `(image uint8
+(H, W, 3), boxes float32 (N, 4) xyxy, labels int32 (N,), voc_dict)` — no
+imgaug objects; augmentation and GT encoding live in `augment.py` /
+`pipeline.py` so this module stays a pure parser.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import time
+import xml.etree.ElementTree as ET
+from typing import Dict, List, Tuple
+
+import numpy as np
+from PIL import Image
+
+CLASS2INDEX = {"hat": 0, "person": 1, "dog": 0}
+INDEX2CLASS = {0: "hat", 1: "person"}
+CLASS2COLOR = {0: (255, 0, 0), 1: (0, 255, 0)}
+
+
+def parse_voc_xml(node: ET.Element) -> Dict:
+    """Recursive XML -> nested dict (ref data.py:65-80)."""
+    voc_dict: Dict = {}
+    children = list(node)
+    if children:
+        def_dic = collections.defaultdict(list)
+        for dc in map(parse_voc_xml, children):
+            for ind, v in dc.items():
+                def_dic[ind].append(v)
+        if node.tag == "annotation":
+            def_dic["object"] = [def_dic["object"]]
+        voc_dict = {node.tag: {ind: v[0] if len(v) == 1 else v
+                               for ind, v in def_dic.items()}}
+    if node.text:
+        text = node.text.strip()
+        if not children:
+            voc_dict[node.tag] = text
+    return voc_dict
+
+
+def boxes_from_voc_dict(voc_dict: Dict) -> Tuple[np.ndarray, np.ndarray]:
+    """Extract (boxes (N, 4) xyxy float32, labels (N,) int32)
+    (ref data.py:55-63)."""
+    boxes: List[List[int]] = []
+    labels: List[int] = []
+    # parse_voc_xml wraps the object list as [[obj1, ..]] then unwraps the
+    # singleton outer list, so this is already the flat list of object dicts.
+    objects = voc_dict.get("annotation", {}).get("object", [])
+    if isinstance(objects, dict):  # defensive: bare dict if ever unwrapped
+        objects = [objects]
+    for obj in objects:
+        if not obj:
+            continue
+        labels.append(CLASS2INDEX[obj["name"].lower()])
+        bb = obj["bndbox"]
+        boxes.append([int(bb["xmin"]), int(bb["ymin"]),
+                      int(bb["xmax"]), int(bb["ymax"])])
+    if not boxes:
+        return (np.zeros((0, 4), np.float32), np.zeros((0,), np.int32))
+    return np.asarray(boxes, np.float32), np.asarray(labels, np.int32)
+
+
+class VOCDataset:
+    """SHWD/VOC2028 image+annotation reader (ref data.py:22-53)."""
+
+    def __init__(self, root: str, image_set: str = "trainval"):
+        image_dir = os.path.join(root, "JPEGImages")
+        annotation_dir = os.path.join(root, "Annotations")
+        splits_dir = os.path.join(root, "ImageSets/Main")
+
+        split_f = os.path.join(splits_dir, image_set.rstrip("\n") + ".txt")
+        with open(split_f) as f:
+            file_names = [x.strip() for x in f.readlines() if x.strip()]
+
+        self.ids = file_names
+        self.images = [os.path.join(image_dir, x + ".jpg") for x in file_names]
+        self.annotations = [os.path.join(annotation_dir, x + ".xml")
+                            for x in file_names]
+        assert len(self.images) == len(self.annotations)
+        print("%s: %d images are loaded from %s"
+              % (time.ctime(), len(self.images), root))
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def __getitem__(self, index: int):
+        img = np.asarray(Image.open(self.images[index]).convert("RGB"))
+        voc_dict = parse_voc_xml(ET.parse(self.annotations[index]).getroot())
+        boxes, labels = boxes_from_voc_dict(voc_dict)
+        return img, boxes, labels, voc_dict

@@ -1,0 +1,68 @@
+"""Config system tests: parse, snapshot round-trip, eval-time arch override
+(ref /root/reference/config.py:139-179 semantics)."""
+
+import dataclasses
+import os
+
+from real_time_helmet_detection_tpu.config import (
+    ARCHITECTURE_FIELDS, Config, get_config, load_config, parse_args,
+    save_config, update_config_for_eval)
+
+
+def test_defaults_match_reference():
+    cfg = parse_args([])
+    # spot-check the reference's defaults (ref config.py:24-128)
+    assert cfg.batch_size == 16
+    assert cfg.lr == 5e-4
+    assert cfg.lr_milestone == [50, 90]
+    assert cfg.lr_gamma == 0.1
+    assert cfg.end_epoch == 100
+    assert cfg.topk == 100
+    assert cfg.conf_th == 0.0
+    assert cfg.nms_th == 0.5
+    assert cfg.num_cls == 2
+    assert cfg.num_stack == 1
+    assert cfg.hourglass_inch == 128
+    assert cfg.multiscale == [320, 512, 64]
+    assert cfg.pretrained == "imagenet"
+    assert not cfg.train_flag and not cfg.multiscale_flag
+
+
+def test_flag_parsing_and_aliases():
+    cfg = parse_args(["--train-flag", "--batch-size", "4", "--num-stack", "2",
+                      "--multiscale", "256", "384", "64", "--multiscale_flag",
+                      "--scale_factor", "4"])
+    assert cfg.train_flag and cfg.batch_size == 4 and cfg.num_stack == 2
+    assert cfg.multiscale == [256, 384, 64] and cfg.multiscale_flag
+    assert cfg.scale_factor == 4
+
+
+def test_snapshot_roundtrip(tmp_path):
+    cfg = parse_args(["--num-stack", "3", "--activation", "Mish"])
+    save_config(cfg, str(tmp_path))
+    assert os.path.exists(tmp_path / "argument.txt")
+    loaded = load_config(str(tmp_path / "argument.json"))
+    assert loaded == cfg
+
+
+def test_eval_override_restores_architecture():
+    trained = dataclasses.replace(Config(), num_stack=4, activation="Mish",
+                                  hourglass_inch=64, normalized_coord=True)
+    cli = dataclasses.replace(Config(), imsize=512, conf_th=0.25)
+    merged = update_config_for_eval(cli, trained)
+    for k in ARCHITECTURE_FIELDS:
+        assert getattr(merged, k) == getattr(trained, k)
+    # non-architecture CLI choices survive
+    assert merged.imsize == 512 and merged.conf_th == 0.25
+
+
+def test_get_config_eval_reads_checkpoint_snapshot(tmp_path):
+    ckpt_dir = tmp_path / "run1"
+    train_cfg = parse_args(["--num-stack", "2", "--activation", "Mish",
+                            "--save-path", str(ckpt_dir)])
+    save_config(train_cfg, str(ckpt_dir))
+    eval_cfg = get_config(["--model-load", str(ckpt_dir / "ckpt_1.msgpack"),
+                           "--imsize", "512",
+                           "--save-path", str(tmp_path / "eval")])
+    assert eval_cfg.num_stack == 2 and eval_cfg.activation == "Mish"
+    assert eval_cfg.imsize == 512

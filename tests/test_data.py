@@ -1,0 +1,161 @@
+"""Data layer tests: VOC parsing, augmentation geometry, batching/sharding
+(ref /root/reference/data.py semantics, SURVEY.md §2 #3-6)."""
+
+import numpy as np
+import pytest
+
+from real_time_helmet_detection_tpu.data import (
+    BatchLoader, TestAugmentor, TrainAugmentor, VOCDataset, collate,
+    make_synthetic_voc)
+from real_time_helmet_detection_tpu.data.augment import (
+    _scaling, filter_boxes, transform_boxes)
+
+
+@pytest.fixture(scope="module")
+def voc_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("voc")
+    return make_synthetic_voc(str(root), num_train=8, num_test=4,
+                              imsize=(160, 120), seed=0)
+
+
+def test_voc_parse(voc_root):
+    ds = VOCDataset(voc_root, "trainval")
+    assert len(ds) == 8
+    img, boxes, labels, info = ds[0]
+    assert img.dtype == np.uint8 and img.shape == (120, 160, 3)
+    assert boxes.shape[1] == 4 and boxes.shape[0] == labels.shape[0] >= 1
+    assert set(labels.tolist()) <= {0, 1}
+    # every <object> in every annotation must surface as a box (multi-object
+    # images especially — a regression here silently corrupts all GT)
+    for i in range(len(ds)):
+        with open(ds.annotations[i]) as f:
+            n_xml = f.read().count("<object>")
+        _, bxs, lbs, _ = ds[i]
+        assert bxs.shape[0] == lbs.shape[0] == n_xml
+    # xml size round-trips (eval rescale depends on it, ref evaluate.py:77-79)
+    size = info["annotation"]["size"]
+    assert int(size["width"]) == 160 and int(size["height"]) == 120
+    # boxes inside the image
+    assert (boxes[:, 0] >= 0).all() and (boxes[:, 2] <= 160).all()
+
+
+def test_transform_boxes_identity_and_scale():
+    boxes = np.array([[10, 20, 30, 40]], np.float32)
+    out = transform_boxes(boxes, np.eye(3))
+    np.testing.assert_allclose(out, boxes)
+    out = transform_boxes(boxes, _scaling(2.0, 0.5))
+    np.testing.assert_allclose(out, [[20, 10, 60, 20]])
+
+
+def test_filter_boxes_removes_and_clips():
+    boxes = np.array([[-20, -20, -5, -5],     # fully outside -> removed
+                      [-10, 10, 30, 40],      # clipped to x1=0
+                      [50, 50, 90, 90]], np.float32)
+    labels = np.array([0, 1, 0], np.int32)
+    b, l = filter_boxes(boxes, labels, (64, 64))
+    assert b.shape[0] == 2 and l.tolist() == [1, 0]
+    np.testing.assert_allclose(b[0], [0, 10, 30, 40])
+    np.testing.assert_allclose(b[1], [50, 50, 64, 64])
+
+
+def test_test_augmentor_exact_box_scaling(voc_root):
+    ds = VOCDataset(voc_root, "test")
+    img, boxes, labels, _ = ds[0]
+    aug = TestAugmentor(imsize=64)
+    imgs, bxs, lbs = aug([img], [boxes], [labels])
+    assert imgs[0].shape == (64, 64, 3)
+    np.testing.assert_allclose(bxs[0][:, 0], boxes[:, 0] * 64 / 160, rtol=1e-5)
+    np.testing.assert_allclose(bxs[0][:, 1], boxes[:, 1] * 64 / 120, rtol=1e-5)
+
+
+def test_train_augmentor_boxes_in_canvas_and_multiscale(voc_root):
+    ds = VOCDataset(voc_root, "trainval")
+    samples = [ds[i] for i in range(4)]
+    rng = np.random.default_rng(3)
+    aug = TrainAugmentor(multiscale_flag=True, multiscale=[64, 128, 32],
+                         rng=rng)
+    sizes = set()
+    for _ in range(6):
+        imgs, bxs, lbs = aug(*map(list, zip(*[(s[0], s[1], s[2]) for s in samples])))
+        size = imgs[0].shape[0]
+        sizes.add(size)
+        # multiscale grid excludes the max endpoint (python range semantics,
+        # ref data.py:154)
+        assert size in (64, 96)
+        for b, l in zip(bxs, lbs):
+            assert b.shape[0] == l.shape[0]
+            if len(b):
+                assert (b[:, 0] >= 0).all() and (b[:, 2] <= size).all()
+                assert (b[:, 1] >= 0).all() and (b[:, 3] <= size).all()
+                assert (b[:, 2] > b[:, 0]).all() and (b[:, 3] > b[:, 1]).all()
+    assert len(sizes) > 1  # actually multiscale
+
+
+def test_collate_shape_law(voc_root):
+    ds = VOCDataset(voc_root, "trainval")
+    samples = [ds[i] for i in range(3)]
+    aug = TestAugmentor(imsize=64)
+    batch = collate(samples, aug, num_cls=2, max_boxes=16)
+    assert batch.image.shape == (3, 64, 64, 3)
+    assert batch.heatmap.shape == (3, 16, 16, 2)
+    assert batch.offset.shape == (3, 16, 16, 2)
+    assert batch.wh.shape == (3, 16, 16, 2)
+    assert batch.mask.shape == (3, 16, 16, 1)
+    assert batch.boxes.shape == (3, 16, 4)
+    assert batch.valid.sum(axis=1).tolist() == [m.sum() for m in batch.mask.reshape(3, -1)]
+    assert batch.image.dtype == np.float32
+    # normalized image: roughly zero-centered
+    assert abs(batch.image.mean()) < 3.0
+
+
+def test_batchloader_sharding_and_reshuffle(voc_root):
+    ds = VOCDataset(voc_root, "trainval")
+    aug = TestAugmentor(imsize=64)
+
+    def loader(rank, world):
+        return BatchLoader(ds, aug, batch_size=2, rank=rank, world_size=world,
+                           seed=5, num_workers=2, max_boxes=8)
+
+    # two-host shards are disjoint and cover everything
+    l0, l1 = loader(0, 2), loader(1, 2)
+    i0, i1 = set(l0._indices().tolist()), set(l1._indices().tolist())
+    assert i0.isdisjoint(i1) and len(i0 | i1) == len(ds)
+
+    # per-epoch reshuffle changes the order deterministically
+    l0.set_epoch(0); e0 = l0._indices().tolist()
+    l0.set_epoch(1); e1 = l0._indices().tolist()
+    l0.set_epoch(0); e0b = l0._indices().tolist()
+    assert e0 != e1 and e0 == e0b
+
+    batches = list(loader(0, 1))
+    assert len(batches) == 4  # 8 imgs / batch 2, drop_last
+    assert all(b.image.shape == (2, 64, 64, 3) for b in batches)
+
+
+def test_batchloader_uneven_shards_padded(voc_root):
+    # 8 train + 4 test images; use a 3-host world so 8 % 3 != 0
+    ds = VOCDataset(voc_root, "trainval")
+    aug = TestAugmentor(imsize=64)
+    lengths = []
+    covered = set()
+    for rank in range(3):
+        l = BatchLoader(ds, aug, batch_size=1, rank=rank, world_size=3,
+                        seed=5, num_workers=1, max_boxes=8)
+        idx = l._indices()
+        lengths.append(len(idx))
+        covered |= set(idx.tolist())
+    # equal per-host length (SPMD lockstep) and full coverage
+    assert len(set(lengths)) == 1 and lengths[0] == 3
+    assert covered == set(range(8))
+
+
+def test_batchloader_producer_error_propagates(voc_root):
+    ds = VOCDataset(voc_root, "trainval")
+
+    class BoomAug:
+        def __call__(self, *a):
+            raise RuntimeError("boom")
+
+    l = BatchLoader(ds, BoomAug(), batch_size=2, num_workers=1, max_boxes=8)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(iter(l))
