@@ -1,0 +1,58 @@
+"""Benchmark: end-to-end inference throughput at 512x512 on one chip.
+
+Headline reference number: 100 FPS at 512x512 on a GTX 1080 Ti via the
+TorchScript C++ app (/root/reference/README.md:76). This benchmark times the
+same fused path — network forward -> sigmoid -> decode -> NMS — as ONE jitted
+XLA program, steady-state, device-synchronized, and reports images/sec.
+
+Prints one JSON line:
+  {"metric": "inference_fps_512", "value": N, "unit": "img/s", "vs_baseline": N/100}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_FPS = 100.0  # reference README.md:76
+BATCH = 8
+IMSIZE = 512
+WARMUP = 3
+ITERS = 20
+
+
+def main() -> None:
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.models import build_model
+    from real_time_helmet_detection_tpu.predict import make_predict_fn
+
+    cfg = Config(num_stack=1, hourglass_inch=128, num_cls=2, topk=100,
+                 conf_th=0.0, nms_th=0.5, imsize=IMSIZE)
+    model = build_model(cfg)
+    rng = jax.random.key(0)
+    images = jnp.asarray(
+        np.random.default_rng(0).standard_normal(
+            (BATCH, IMSIZE, IMSIZE, 3)).astype(np.float32))
+    variables = model.init(rng, images[:1], train=False)
+    predict = make_predict_fn(model, cfg)
+
+    for _ in range(WARMUP):
+        jax.block_until_ready(predict(variables, images))
+
+    tic = time.perf_counter()
+    for _ in range(ITERS):
+        jax.block_until_ready(predict(variables, images))
+    dt = time.perf_counter() - tic
+
+    fps = BATCH * ITERS / dt
+    print(json.dumps({"metric": "inference_fps_512",
+                      "value": round(fps, 2), "unit": "img/s",
+                      "vs_baseline": round(fps / BASELINE_FPS, 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,7 @@ class Config:
 
     # device
     num_devices: int = 0          # 0 = use every visible device
+    platform: str = ""            # force a jax platform ("cpu"/"tpu"); "" = default
     random_seed: int = 777
 
     # train
@@ -118,6 +119,9 @@ class Config:
     # data-pipeline limits (TPU static shapes; no reference analogue)
     max_boxes: int = 128          # per-image GT padding for encode
 
+    # kernels
+    use_pallas: bool = True       # fused Pallas peak kernel on TPU decode
+
     # log
     print_interval: int = 100
     save_path: str = "./WEIGHTS/"
@@ -132,7 +136,10 @@ def build_parser() -> argparse.ArgumentParser:
         default = (f.default_factory() if f.default_factory is not dataclasses.MISSING
                    else f.default)
         if f.type in ("bool", bool):
-            parser.add_argument(flag, action="store_true", default=default)
+            # BooleanOptionalAction adds --no-<flag>, so default-True bools
+            # (e.g. --use-pallas) can actually be switched off from the CLI
+            parser.add_argument(flag, action=argparse.BooleanOptionalAction,
+                                default=default)
         elif isinstance(default, list):
             elem = type(default[0]) if default else str
             parser.add_argument(flag, type=elem, nargs="+", default=default)
@@ -195,6 +202,12 @@ def get_config(argv=None) -> Config:
     eval-time architecture restore."""
     cfg = parse_args(argv)
     seed_everything(cfg.random_seed)
+
+    if cfg.platform:
+        # must happen before the first backend init; the env var alone is
+        # unreliable here (a sitecustomize pins the platform at startup)
+        import jax
+        jax.config.update("jax_platforms", cfg.platform)
 
     os.makedirs(cfg.save_path, exist_ok=True)
     if cfg.train_flag:

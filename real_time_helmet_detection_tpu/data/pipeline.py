@@ -192,7 +192,8 @@ def load_dataset(cfg, rng: Optional[np.random.Generator] = None):
             rng=rng or np.random.default_rng(cfg.random_seed))
         image_set = "trainval"
     else:
-        augmentor = TestAugmentor(imsize=cfg.imsize)
+        # 512 default matches the reference README's eval invocation
+        augmentor = TestAugmentor(imsize=cfg.imsize or 512)
         image_set = "test"
     dataset = VOCDataset(cfg.data, image_set=image_set)
     return dataset, augmentor

@@ -1,5 +1,6 @@
 from .hourglass import (
     Activation,
+    build_model,
     Convolution,
     Head,
     Hourglass,
@@ -14,6 +15,7 @@ from .hourglass import (
 
 __all__ = [
     "Activation",
+    "build_model",
     "Convolution",
     "Head",
     "Hourglass",

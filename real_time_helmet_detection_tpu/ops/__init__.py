@@ -1,5 +1,5 @@
 from .encode import encode_boxes, encode_boxes_batch, encode_boxes_jax, gaussian_radius
-from .decode import decode_heatmap, peak_mask
+from .decode import decode_heatmap, decode_peak_scores, peak_mask
 from .loss import focal_loss, normed_l1_loss, detection_loss, LossLog
 from .nms import nms_mask, soft_nms_mask
 
@@ -9,6 +9,7 @@ __all__ = [
     "encode_boxes_jax",
     "gaussian_radius",
     "decode_heatmap",
+    "decode_peak_scores",
     "peak_mask",
     "focal_loss",
     "normed_l1_loss",

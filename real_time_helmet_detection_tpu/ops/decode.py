@@ -49,26 +49,17 @@ def peak_mask(heatmap: jax.Array) -> jax.Array:
 
 
 @partial(jax.jit, static_argnames=("scale_factor", "topk", "normalized"))
-def decode_heatmap(heatmap: jax.Array, offset: jax.Array, wh: jax.Array,
-                   scale_factor: int = 4, topk: int = 100,
-                   conf_th: float = 0.3, normalized: bool = False) -> Detections:
-    """Decode one image's maps into top-k boxes.
+def decode_peak_scores(peaks: jax.Array, offset: jax.Array, wh: jax.Array,
+                       scale_factor: int = 4, topk: int = 100,
+                       conf_th: float = 0.3, normalized: bool = False) -> Detections:
+    """Decode pre-masked peak scores into top-k boxes.
 
-    Args:
-      heatmap: (H, W, C) post-sigmoid class heatmap.
-      offset: (H, W, 2) center offsets (x, y).
-      wh: (H, W, 2) box sizes (w, h).
-      scale_factor: map -> image upsample factor.
-      topk: number of peaks to keep (static).
-      conf_th: confidence threshold, applied as the `valid` mask.
-      normalized: if True, un-normalize offsets (*scale_factor) and sizes
-        (*map width/height) as in the reference.
-
-    Returns a `Detections` with static shapes.
+    `peaks` is the (H, W, C) map where non-peak cells are already zeroed
+    (e.g. the output of the fused Pallas kernel `ops.pallas.fused_peak_scores`
+    or the XLA peak test in `decode_heatmap`). Remaining steps: flat top-k,
+    gather, un-normalize, box reconstruction (ref transform.py:81-110).
     """
-    height, width, num_cls = heatmap.shape
-
-    peaks = jnp.where(peak_mask(heatmap), heatmap, 0.0)
+    height, width, num_cls = peaks.shape
 
     # Flatten class-major (C, H, W) to match the reference's index layout
     # (class = idx // (H*W)), keeping tie-break ordering identical.
@@ -104,3 +95,27 @@ def decode_heatmap(heatmap: jax.Array, offset: jax.Array, wh: jax.Array,
     valid = scores >= conf_th
     return Detections(boxes=boxes, classes=clss.astype(jnp.int32),
                       scores=scores, valid=valid)
+
+
+@partial(jax.jit, static_argnames=("scale_factor", "topk", "normalized"))
+def decode_heatmap(heatmap: jax.Array, offset: jax.Array, wh: jax.Array,
+                   scale_factor: int = 4, topk: int = 100,
+                   conf_th: float = 0.3, normalized: bool = False) -> Detections:
+    """Decode one image's maps into top-k boxes.
+
+    Args:
+      heatmap: (H, W, C) post-sigmoid class heatmap.
+      offset: (H, W, 2) center offsets (x, y).
+      wh: (H, W, 2) box sizes (w, h).
+      scale_factor: map -> image upsample factor.
+      topk: number of peaks to keep (static).
+      conf_th: confidence threshold, applied as the `valid` mask.
+      normalized: if True, un-normalize offsets (*scale_factor) and sizes
+        (*map width/height) as in the reference.
+
+    Returns a `Detections` with static shapes.
+    """
+    peaks = jnp.where(peak_mask(heatmap), heatmap, 0.0)
+    return decode_peak_scores(peaks, offset, wh, scale_factor=scale_factor,
+                              topk=topk, conf_th=conf_th,
+                              normalized=normalized)

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the detection hot paths."""
+
+from .peak import fused_peak_scores, peak_scores_reference
+
+__all__ = ["fused_peak_scores", "peak_scores_reference"]

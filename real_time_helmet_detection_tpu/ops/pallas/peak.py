@@ -1,0 +1,89 @@
+"""Fused sigmoid + 3x3 peak-test Pallas TPU kernel.
+
+The eval hot path's "NMS kernel" (SURVEY.md §2 #8): the reference computes
+`sigmoid` then `MaxPool2d(3, stride=1, pad=1)` then an equality test then a
+zero-fill (/root/reference/transform.py:76-79, evaluate.py:139) — four
+HBM-bound elementwise/window passes in PyTorch. Here they fuse into ONE
+VMEM-resident Pallas kernel:
+
+* one grid step per class channel; the (H, W) map lives in VMEM
+  (128x128 fp32 at 512-input = 64 KB, far under the ~16 MB budget);
+* the 3x3 window max is built from 2 shifted row-maxes of a horizontal
+  3-max (separable decomposition: 4 `jnp.maximum`s on the VPU instead of a
+  9-tap window);
+* the peak test runs on the *sigmoid* values, exactly as the production XLA
+  path does (sigmoid first, then the window-max equality). Testing on raw
+  logits would be mathematically equivalent but not float32-identical:
+  sigmoid saturates, so distinct large logits can round to the same sigmoid
+  value and the tie-counting `==` test then admits *more* peaks — the two
+  paths must agree bit-for-bit for cross-platform reproducibility.
+
+`fused_peak_scores` falls back to Pallas interpret mode off-TPU so the same
+code path is testable on the CPU mesh (tests/test_pallas.py checks exact
+agreement with the XLA reference implementation `peak_scores_reference`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30  # python scalar: a jnp constant would be captured by the kernel
+
+
+def peak_scores_reference(logits: jax.Array) -> jax.Array:
+    """XLA reference: masked sigmoid peak scores.
+
+    logits: (H, W, C) raw heatmap logits. Returns (H, W, C) where local
+    maxima of the *sigmoid* map (3x3, ties count) carry their sigmoid score
+    and all else is 0 — bit-identical to the production decode path
+    (`jnp.where(peak_mask(sigmoid(x)), sigmoid(x), 0)`).
+    """
+    from ..decode import peak_mask
+    heat = jax.nn.sigmoid(logits)
+    return jnp.where(peak_mask(heat), heat, 0.0)
+
+
+def _peak_kernel(x_ref, out_ref):
+    """One class channel: (1, H, W) logits block -> masked sigmoid scores."""
+    x = jax.nn.sigmoid(x_ref[0])  # (H, W); peak test in sigmoid space
+    # horizontal 3-max
+    left = jnp.concatenate([jnp.full((x.shape[0], 1), _NEG), x[:, :-1]], axis=1)
+    right = jnp.concatenate([x[:, 1:], jnp.full((x.shape[0], 1), _NEG)], axis=1)
+    h3 = jnp.maximum(jnp.maximum(left, x), right)
+    # vertical 3-max of the horizontal max = full 3x3 window max
+    up = jnp.concatenate([jnp.full((1, x.shape[1]), _NEG), h3[:-1, :]], axis=0)
+    down = jnp.concatenate([h3[1:, :], jnp.full((1, x.shape[1]), _NEG)], axis=0)
+    pooled = jnp.maximum(jnp.maximum(up, h3), down)
+    out_ref[0] = jnp.where(pooled == x, x, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _fused_chw(logits_chw: jax.Array, interpret: bool = False) -> jax.Array:
+    c, h, w = logits_chw.shape
+    return pl.pallas_call(
+        _peak_kernel,
+        grid=(c,),
+        in_specs=[pl.BlockSpec((1, h, w), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, h, w), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((c, h, w), jnp.float32),
+        interpret=interpret,
+    )(logits_chw.astype(jnp.float32))
+
+
+def fused_peak_scores(logits: jax.Array, interpret: bool | None = None) -> jax.Array:
+    """Pallas-fused peak scores, channels-last in/out.
+
+    logits: (H, W, C) raw heatmap logits -> (H, W, C) masked sigmoid scores.
+    `interpret=None` auto-selects interpret mode off-TPU (testability).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    chw = jnp.transpose(logits, (2, 0, 1))
+    return jnp.transpose(_fused_chw(chw, interpret=interpret), (1, 2, 0))

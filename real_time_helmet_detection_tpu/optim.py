@@ -1,0 +1,44 @@
+"""Optimizer + LR schedule construction (optax).
+
+Capability parity with the reference optimizer module
+(/root/reference/optim.py:3-12: Adam + `MultiStepLR` milestones [50, 90]
+gamma 0.1), re-designed for step-based optax schedules:
+
+* the epoch-milestone `MultiStepLR` becomes a `piecewise_constant_schedule`
+  whose boundaries are `milestone * steps_per_epoch` (the reference steps
+  its scheduler once per epoch, ref train.py:74);
+* `--optim` actually selects the optimizer here (Adam | AdamW | SGD) — in
+  the reference the flag is parsed but Adam is hard-coded (ref optim.py:4,
+  SURVEY.md §5 dead flags);
+* gradient accumulation (`--sub-divisions`, ref train.py:124-139) is
+  `optax.MultiSteps`, which applies the averaged update every k-th step —
+  the same micro-batch semantics without any host-side flag juggling.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def make_lr_schedule(cfg, steps_per_epoch: int) -> optax.Schedule:
+    """MultiStepLR equivalent: lr * gamma^k after each milestone epoch."""
+    boundaries = {int(m) * steps_per_epoch: cfg.lr_gamma
+                  for m in cfg.lr_milestone if int(m) > 0}
+    return optax.piecewise_constant_schedule(cfg.lr, boundaries)
+
+
+def build_optimizer(cfg, steps_per_epoch: int) -> optax.GradientTransformation:
+    """Construct the optax transformation from config flags."""
+    schedule = make_lr_schedule(cfg, steps_per_epoch)
+    name = cfg.optim.lower()
+    if name == "adam":
+        tx = optax.adam(schedule)
+    elif name == "adamw":
+        tx = optax.adamw(schedule)
+    elif name == "sgd":
+        tx = optax.sgd(schedule, momentum=0.9)
+    else:
+        raise NotImplementedError("Not expected optimizer: %s" % cfg.optim)
+    if cfg.sub_divisions > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=cfg.sub_divisions)
+    return tx

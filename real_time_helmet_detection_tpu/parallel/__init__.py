@@ -1,0 +1,23 @@
+"""Parallelism layer: device mesh, shardings, distributed init.
+
+The TPU-native replacement for the reference's DDP stack
+(/root/reference/train.py:23-45 `mp.spawn` + NCCL process groups): one
+process per host, a `jax.sharding.Mesh` over all devices, GSPMD-partitioned
+jit instead of gradient-hook all-reduce.
+"""
+
+from .mesh import (
+    batch_sharding,
+    init_distributed,
+    make_mesh,
+    replicated,
+    shard_batch,
+)
+
+__all__ = [
+    "batch_sharding",
+    "init_distributed",
+    "make_mesh",
+    "replicated",
+    "shard_batch",
+]

@@ -118,7 +118,11 @@ def arr2pil(images: np.ndarray, pretrained: Optional[str] = "imagenet") -> Image
 
 def draw_box(pil: Image.Image, box, width: int = 2, color=(0, 0, 255)) -> Image.Image:
     draw = ImageDraw.Draw(pil)
-    draw.rectangle(list(map(int, box)), width=width, outline=color, fill=None)
+    # order the corners: a raw size regression can emit inverted boxes
+    # (negative w/h) early in training, which PIL refuses to draw
+    x1, y1, x2, y2 = map(int, box)
+    draw.rectangle([min(x1, x2), min(y1, y2), max(x1, x2), max(y1, y2)],
+                   width=width, outline=color, fill=None)
     return pil
 
 

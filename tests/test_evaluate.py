@@ -1,0 +1,113 @@
+"""End-to-end inference tests: predictor shapes, eval driver on the
+synthetic fixture dataset, demo overlay, and the train->eval overfit loop
+(SURVEY.md §4 invariant (6): end-to-end mAP on a tiny fixture dataset).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_helmet_detection_tpu.config import Config
+from real_time_helmet_detection_tpu.data import make_synthetic_voc
+from real_time_helmet_detection_tpu.models import build_model
+from real_time_helmet_detection_tpu.predict import make_predict_fn
+
+
+def tiny_cfg(**kw):
+    base = dict(num_stack=2, hourglass_inch=16, num_cls=2, topk=10,
+                conf_th=0.1, nms_th=0.5, imsize=64, batch_size=2,
+                num_workers=2, print_interval=1)
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.fixture(scope="module")
+def fixture_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("voc")
+    return make_synthetic_voc(str(root), num_train=6, num_test=4,
+                              imsize=(96, 72), seed=1)
+
+
+def test_predict_fn_shapes():
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    imgs = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), imgs, train=False)
+    predict = make_predict_fn(model, cfg)
+    dets = jax.device_get(predict(variables, imgs))
+    n = cfg.num_stack * cfg.topk
+    assert dets.boxes.shape == (2, n, 4)
+    assert dets.classes.shape == (2, n)
+    assert dets.scores.shape == (2, n)
+    assert dets.valid.shape == (2, n)
+    assert dets.valid.dtype == bool
+
+
+def test_predict_fn_soft_nms_runs():
+    cfg = tiny_cfg(nms="soft-nms")
+    model = build_model(cfg)
+    imgs = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), imgs, train=False)
+    dets = jax.device_get(make_predict_fn(model, cfg)(variables, imgs))
+    assert dets.boxes.shape == (1, cfg.num_stack * cfg.topk, 4)
+
+
+def test_predict_rejects_unknown_nms():
+    cfg = tiny_cfg(nms="magic")
+    model = build_model(cfg)
+    with pytest.raises(NotImplementedError):
+        make_predict_fn(model, cfg)
+
+
+def test_evaluate_driver_writes_artifacts(fixture_root, tmp_path):
+    from real_time_helmet_detection_tpu.evaluate import evaluate
+
+    cfg = tiny_cfg(data=fixture_root, save_path=str(tmp_path),
+                   train_flag=False)
+    m = evaluate(cfg)
+    assert "map" in m and 0.0 <= m["map"] <= 1.0
+    assert os.path.exists(os.path.join(str(tmp_path),
+                                       "prediction_results.pickle"))
+    txt_dir = os.path.join(str(tmp_path), "results", "txt")
+    assert len(os.listdir(txt_dir)) == 4  # one per test image
+
+
+def test_demo_writes_overlay(fixture_root, tmp_path):
+    from real_time_helmet_detection_tpu.evaluate import demo
+
+    img = os.path.join(fixture_root, "JPEGImages",
+                       sorted(os.listdir(os.path.join(fixture_root,
+                                                      "JPEGImages")))[0])
+    cfg = tiny_cfg(data=img, save_path=str(tmp_path))
+    out = demo(cfg)
+    assert os.path.exists(os.path.join(str(tmp_path), "image.png"))
+    assert out["boxes"].shape[1] == 4 if len(out["boxes"]) else True
+
+
+@pytest.mark.slow
+def test_overfit_tiny_dataset_end_to_end(fixture_root, tmp_path):
+    """Train on the fixture until the loss drops, checkpoint, then eval the
+    checkpoint through the full driver — the minimum end-to-end slice
+    (SURVEY.md §7 step 4)."""
+    from real_time_helmet_detection_tpu.train import train
+    from real_time_helmet_detection_tpu.evaluate import evaluate
+
+    save = str(tmp_path / "w")
+    os.makedirs(os.path.join(save, "training_log"), exist_ok=True)
+    # imsize must be divisible by 4 * 2^4 (stem stride x hourglass depth);
+    # multiscale_flag samples from range(64, 128, 64) = {64} every batch.
+    cfg = tiny_cfg(train_flag=True, data=fixture_root, save_path=save,
+                   end_epoch=2, lr=1e-3, batch_size=2, multiscale_flag=True,
+                   multiscale=[64, 128, 64], imsize=None)
+    state = train(cfg)
+    ckpts = [d for d in os.listdir(save) if d.startswith("check_point_")]
+    assert "check_point_2" in ckpts
+
+    eval_cfg = tiny_cfg(train_flag=False, data=fixture_root, save_path=save,
+                        model_load=os.path.join(save, "check_point_2"),
+                        imsize=64)
+    m = evaluate(eval_cfg)
+    assert np.isfinite(m["map"])

@@ -1,0 +1,84 @@
+"""Tests for the hermetic VOC mAP evaluator (metrics.py).
+
+The reference delegates AP to the external Cartucho/mAP submodule
+(SURVEY.md §2.2); these tests pin our in-repo implementation to the
+definition that tool uses (all-point interpolated AP, IoU>=0.5, greedy
+matching, duplicates are FPs).
+"""
+
+import numpy as np
+import pytest
+
+from real_time_helmet_detection_tpu.metrics import (
+    box_iou, compute_class_ap, compute_map, compute_map_from_txt, voc_ap,
+    write_detection_txt, read_detection_txt)
+
+
+def test_box_iou_basic():
+    box = np.array([0, 0, 10, 10], np.float32)
+    others = np.array([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]],
+                      np.float32)
+    iou = box_iou(box, others)
+    assert iou[0] == pytest.approx(1.0)
+    assert iou[1] == pytest.approx(25.0 / 175.0)
+    assert iou[2] == pytest.approx(0.0)
+
+
+def test_voc_ap_perfect():
+    assert voc_ap(np.array([0.5, 1.0]), np.array([1.0, 1.0])) == pytest.approx(1.0)
+
+
+def test_voc_ap_half():
+    # one TP at rank 1, one FP at rank 2, 2 GT: recall [0.5,0.5], prec [1,0.5]
+    ap = voc_ap(np.array([0.5, 0.5]), np.array([1.0, 0.5]))
+    assert ap == pytest.approx(0.5)
+
+
+def test_class_ap_perfect_detection():
+    gt = {"a": np.array([[0, 0, 10, 10], [20, 20, 40, 40]], np.float32)}
+    dets = [("a", 0.9, np.array([0, 0, 10, 10], np.float32)),
+            ("a", 0.8, np.array([20, 20, 40, 40], np.float32))]
+    ap, n = compute_class_ap(gt, dets)
+    assert n == 2 and ap == pytest.approx(1.0)
+
+
+def test_class_ap_duplicate_is_fp():
+    gt = {"a": np.array([[0, 0, 10, 10]], np.float32)}
+    dets = [("a", 0.9, np.array([0, 0, 10, 10], np.float32)),
+            ("a", 0.8, np.array([1, 1, 10, 10], np.float32))]  # duplicate
+    ap, _ = compute_class_ap(gt, dets)
+    assert ap == pytest.approx(1.0)  # TP first; dup FP doesn't reduce AP here
+
+
+def test_class_ap_low_iou_is_fp():
+    gt = {"a": np.array([[0, 0, 10, 10]], np.float32)}
+    dets = [("a", 0.9, np.array([8, 8, 20, 20], np.float32))]
+    ap, _ = compute_class_ap(gt, dets)
+    assert ap == pytest.approx(0.0)
+
+
+def test_compute_map_two_classes():
+    gt_boxes = {"a": np.array([[0, 0, 10, 10], [30, 30, 50, 50]], np.float32)}
+    gt_labels = {"a": np.array([0, 1])}
+    det_boxes = {"a": np.array([[0, 0, 10, 10], [30, 30, 50, 50]], np.float32)}
+    det_labels = {"a": np.array([0, 1])}
+    det_scores = {"a": np.array([0.9, 0.8])}
+    m = compute_map(gt_boxes, gt_labels, det_boxes, det_labels, det_scores)
+    assert m["map"] == pytest.approx(1.0)
+    assert m["num_gt"] == {0: 1, 1: 1}
+
+
+def test_txt_roundtrip_and_scoring(tmp_path):
+    boxes = np.array([[1.5, 2.5, 30.0, 40.0]], np.float32)
+    labels = np.array([1])
+    scores = np.array([0.75], np.float32)
+    d = str(tmp_path / "txt")
+    write_detection_txt(d, "img0", boxes, labels, scores)
+    rb, rl, rs = read_detection_txt(str(tmp_path / "txt" / "img0.txt"))
+    np.testing.assert_allclose(rb, boxes, rtol=1e-6)
+    assert rl.tolist() == [1] and rs[0] == pytest.approx(0.75)
+
+    m = compute_map_from_txt(d, {"img0": boxes}, {"img0": labels})
+    assert m["ap"][1] == pytest.approx(1.0)
+    # class 0 has no GT and no detections -> NaN, excluded from mean
+    assert m["map"] == pytest.approx(1.0)

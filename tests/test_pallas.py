@@ -1,0 +1,70 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh).
+
+The fused sigmoid+peak kernel must agree EXACTLY with the XLA path used by
+`ops.decode` — decode correctness (and thus mAP) depends on identical peak
+sets and scores.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_helmet_detection_tpu.ops.decode import decode_heatmap, peak_mask
+from real_time_helmet_detection_tpu.ops.pallas import (fused_peak_scores,
+                                                       peak_scores_reference)
+
+
+@pytest.mark.parametrize("shape", [(32, 32, 2), (16, 24, 3)])
+def test_fused_peak_matches_xla_reference(shape):
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal(shape).astype(np.float32) * 3)
+    got = fused_peak_scores(logits, interpret=True)
+    want = peak_scores_reference(logits)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_fused_peak_plateau_ties_count_as_peaks():
+    # A flat plateau: every cell equals the 3x3 max -> all are peaks
+    # (matches the reference's `==` test, ref transform.py:79).
+    logits = jnp.zeros((8, 8, 1), jnp.float32)
+    got = np.asarray(fused_peak_scores(logits, interpret=True))
+    np.testing.assert_allclose(got, np.full((8, 8, 1), 0.5), rtol=1e-6)
+
+
+def test_fused_peak_single_maximum():
+    logits = jnp.full((9, 9, 1), -5.0, jnp.float32).at[4, 4, 0].set(2.0)
+    got = np.asarray(fused_peak_scores(logits, interpret=True))
+    assert got[4, 4, 0] == pytest.approx(float(jax.nn.sigmoid(2.0)), rel=1e-6)
+    # neighbors of the max are suppressed; far cells are their own local max
+    assert got[4, 5, 0] == 0.0 and got[3, 4, 0] == 0.0
+
+
+def test_fused_peak_saturated_plateau_matches_xla():
+    """Regression: distinct large logits saturate to sigmoid==1.0 in fp32;
+    the peak test must run in sigmoid space so both cells tie as peaks,
+    exactly like the XLA production path."""
+    logits = jnp.full((8, 8, 1), -3.0, jnp.float32)
+    logits = logits.at[2, 2, 0].set(18.2).at[2, 3, 0].set(19.0)
+    got = np.asarray(fused_peak_scores(logits, interpret=True))
+    want = np.asarray(peak_scores_reference(logits))
+    np.testing.assert_array_equal(got, want)
+    assert got[2, 2, 0] == 1.0 and got[2, 3, 0] == 1.0  # both saturated ties
+
+
+def test_decode_consistent_with_fused_scores():
+    """Running top-k on the fused scores reproduces decode_heatmap's
+    peak/score selection."""
+    rng = np.random.default_rng(1)
+    h = w = 16
+    logits = jnp.asarray(rng.standard_normal((h, w, 2)).astype(np.float32))
+    heat = jax.nn.sigmoid(logits)
+    offset = jnp.zeros((h, w, 2))
+    wh = jnp.ones((h, w, 2))
+
+    dets = decode_heatmap(heat, offset, wh, topk=10, conf_th=0.0)
+    fused = fused_peak_scores(logits, interpret=True)
+    flat = jnp.transpose(fused, (2, 0, 1)).reshape(-1)
+    scores, idx = jax.lax.top_k(flat, 10)
+    np.testing.assert_allclose(np.asarray(scores), np.asarray(dets.scores),
+                               rtol=1e-6)

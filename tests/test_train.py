@@ -1,0 +1,228 @@
+"""Training-runtime tests: step mechanics, LR schedule, grad accumulation,
+DP gradient equality, checkpoint round-trip, loss decrease.
+
+Encodes SURVEY.md §4's implicit invariants (3) loss on fixed synthetic
+batches and (5) DP-vs-single-device gradient equality on the fake 8-device
+CPU backend.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_helmet_detection_tpu.config import Config
+from real_time_helmet_detection_tpu.models import build_model
+from real_time_helmet_detection_tpu.optim import build_optimizer, make_lr_schedule
+from real_time_helmet_detection_tpu.parallel import make_mesh, shard_batch
+from real_time_helmet_detection_tpu.train import (
+    TrainState, create_train_state, load_checkpoint, loss_fn, make_train_step,
+    restore_params_only, save_checkpoint)
+from real_time_helmet_detection_tpu.ops.loss import LossLog
+
+IMSIZE = 64
+MAP = IMSIZE // 4
+
+
+def tiny_cfg(**kw):
+    base = dict(num_stack=1, hourglass_inch=16, num_cls=2, batch_size=4,
+                lr=1e-3)
+    base.update(kw)
+    return Config(**base)
+
+
+def synthetic_batch(b=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((b, IMSIZE, IMSIZE, 3)).astype(np.float32),
+            rng.uniform(0, 1, (b, MAP, MAP, 2)).astype(np.float32),
+            rng.uniform(0, 1, (b, MAP, MAP, 2)).astype(np.float32),
+            rng.uniform(1, 8, (b, MAP, MAP, 2)).astype(np.float32),
+            (rng.uniform(0, 1, (b, MAP, MAP, 1)) < 0.05).astype(np.float32))
+
+
+def make_state(cfg, steps_per_epoch=10):
+    model = build_model(cfg)
+    tx = build_optimizer(cfg, steps_per_epoch)
+    state = create_train_state(model, cfg, jax.random.key(0), IMSIZE, tx)
+    return model, tx, state
+
+
+def test_lr_schedule_multistep():
+    cfg = tiny_cfg(lr=1.0, lr_milestone=[2, 4], lr_gamma=0.1)
+    sched = make_lr_schedule(cfg, steps_per_epoch=10)
+    assert sched(0) == pytest.approx(1.0)
+    assert sched(19) == pytest.approx(1.0)
+    assert sched(20) == pytest.approx(0.1)
+    assert sched(40) == pytest.approx(0.01)
+
+
+def test_train_step_runs_and_updates():
+    cfg = tiny_cfg()
+    model, tx, state = make_state(cfg)
+    mesh = make_mesh(1)
+    step = make_train_step(model, tx, cfg, mesh)
+    batch = shard_batch(mesh, synthetic_batch(), spatial_dims=[1] * 5)
+    p0 = jax.device_get(jax.tree.leaves(state.params)[0]).copy()
+    state, losses = step(state, *batch)
+    assert int(state.step) == 1
+    assert np.isfinite(float(losses["total"]))
+    p1 = jax.device_get(jax.tree.leaves(state.params)[0])
+    assert not np.allclose(p0, p1)
+
+
+def test_loss_decreases_over_steps():
+    cfg = tiny_cfg(lr=5e-3)
+    model, tx, state = make_state(cfg)
+    mesh = make_mesh(1)
+    step = make_train_step(model, tx, cfg, mesh)
+    batch = shard_batch(mesh, synthetic_batch(), spatial_dims=[1] * 5)
+    first = last = None
+    for i in range(8):
+        state, losses = step(state, *batch)
+        v = float(losses["total"])
+        first = v if first is None else first
+        last = v
+    assert last < first
+
+
+def test_dp_gradients_match_single_device():
+    """SURVEY §4 invariant (5): same global batch, 1-device vs 8-device DP
+    meshes produce identical losses and updated params."""
+    cfg = tiny_cfg(batch_size=8)
+    model, tx, state = make_state(cfg)
+    batch_np = synthetic_batch(b=8, seed=3)
+
+    results = []
+    for ndev in (1, 8):
+        mesh = make_mesh(ndev)
+        step = make_train_step(model, tx, cfg, mesh)
+        st = jax.tree.map(lambda x: jnp.array(np.asarray(x)), state)
+        batch = shard_batch(mesh, batch_np, spatial_dims=[1] * 5)
+        st, losses = step(st, *batch)
+        results.append((jax.device_get(losses),
+                        jax.device_get(jax.tree.leaves(st.params)[0])))
+    (l1, p1), (l8, p8) = results
+    assert l1["total"] == pytest.approx(l8["total"], rel=1e-4)
+    np.testing.assert_allclose(p1, p8, rtol=1e-4, atol=1e-6)
+
+
+def test_spatial_sharding_matches_pure_dp():
+    """(data=4, spatial=2) must be numerically equivalent to (8, 1)."""
+    cfg = tiny_cfg(batch_size=8)
+    model, tx, state = make_state(cfg)
+    batch_np = synthetic_batch(b=8, seed=5)
+
+    results = []
+    for spatial in (1, 2):
+        mesh = make_mesh(8, spatial=spatial)
+        step = make_train_step(model, tx, cfg, mesh)
+        st = jax.tree.map(lambda x: jnp.array(np.asarray(x)), state)
+        batch = shard_batch(mesh, batch_np, spatial_dims=[1] * 5)
+        st, losses = step(st, *batch)
+        results.append(jax.device_get(losses))
+    assert results[0]["total"] == pytest.approx(results[1]["total"], rel=1e-4)
+
+
+def test_gradient_accumulation_semantics():
+    """MultiSteps(k=2): params only change every 2nd step (ref
+    train.py:124-139 sub-divisions)."""
+    cfg = tiny_cfg(sub_divisions=2)
+    model, tx, state = make_state(cfg)
+    mesh = make_mesh(1)
+    step = make_train_step(model, tx, cfg, mesh)
+    batch = shard_batch(mesh, synthetic_batch(), spatial_dims=[1] * 5)
+
+    p0 = jax.device_get(jax.tree.leaves(state.params)[0]).copy()
+    state, _ = step(state, *batch)
+    p_mid = jax.device_get(jax.tree.leaves(state.params)[0])
+    np.testing.assert_allclose(p0, p_mid)  # accumulated, not applied
+    state, _ = step(state, *batch)
+    p_end = jax.device_get(jax.tree.leaves(state.params)[0])
+    assert not np.allclose(p0, p_end)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    cfg = tiny_cfg()
+    model, tx, state = make_state(cfg)
+    mesh = make_mesh(1)
+    step = make_train_step(model, tx, cfg, mesh)
+    batch = shard_batch(mesh, synthetic_batch(), spatial_dims=[1] * 5)
+    state, losses = step(state, *batch)
+
+    log = LossLog()
+    log.append({k: float(v) for k, v in jax.device_get(losses).items()})
+    path = save_checkpoint(str(tmp_path), 4, state, log)
+    assert os.path.basename(path) == "check_point_5"  # ref naming: epoch+1
+
+    _, _, fresh = make_state(cfg)
+    restored, epoch, rlog = load_checkpoint(path, fresh)
+    assert epoch == 4
+    assert rlog.log["total"] == log.log["total"]
+    np.testing.assert_allclose(
+        jax.device_get(jax.tree.leaves(restored.params)[0]),
+        jax.device_get(jax.tree.leaves(state.params)[0]))
+
+    _, _, fresh2 = make_state(cfg)
+    evald = restore_params_only(path, fresh2)
+    np.testing.assert_allclose(
+        jax.device_get(jax.tree.leaves(evald.params)[0]),
+        jax.device_get(jax.tree.leaves(state.params)[0]))
+    # optimizer state NOT restored on the params-only path
+    assert jax.tree.structure(evald.opt_state) == jax.tree.structure(fresh2.opt_state)
+
+
+def test_eval_restore_ignores_optimizer_config(tmp_path):
+    """Regression: a checkpoint trained with --sub-divisions 2 (MultiSteps
+    wraps the opt state) must be loadable for eval with the default
+    optimizer config."""
+    cfg = tiny_cfg(sub_divisions=2)
+    model, tx, state = make_state(cfg)
+    mesh = make_mesh(1)
+    step = make_train_step(model, tx, cfg, mesh)
+    batch = shard_batch(mesh, synthetic_batch(), spatial_dims=[1] * 5)
+    state, losses = step(state, *batch)
+    path = save_checkpoint(str(tmp_path), 0, state, LossLog())
+
+    eval_cfg = tiny_cfg()  # sub_divisions back at 1
+    _, _, fresh = make_state(eval_cfg)
+    restored = restore_params_only(path, fresh)
+    np.testing.assert_allclose(
+        jax.device_get(jax.tree.leaves(restored.params)[0]),
+        jax.device_get(jax.tree.leaves(state.params)[0]))
+
+
+def test_resume_mismatched_optimizer_raises(tmp_path):
+    """Full resume with a different optimizer config must fail loudly."""
+    cfg = tiny_cfg(sub_divisions=2)
+    model, tx, state = make_state(cfg)
+    path = save_checkpoint(str(tmp_path), 0, state, LossLog())
+    _, _, fresh = make_state(tiny_cfg())  # plain adam structure
+    with pytest.raises(ValueError, match="sub-divisions"):
+        load_checkpoint(path, fresh)
+
+
+def test_bool_flags_negatable():
+    """Regression: default-True bools must be switchable off on the CLI."""
+    from real_time_helmet_detection_tpu.config import parse_args
+    assert parse_args([]).use_pallas is True
+    assert parse_args(["--no-use-pallas"]).use_pallas is False
+    assert parse_args(["--train-flag"]).train_flag is True
+
+
+def test_bf16_policy_step_runs():
+    """--amp selects bf16 compute; step must run and return finite fp32 loss."""
+    cfg = tiny_cfg(amp=True)
+    model = build_model(cfg, dtype=jnp.bfloat16)
+    tx = build_optimizer(cfg, 10)
+    state = create_train_state(model, cfg, jax.random.key(0), IMSIZE, tx)
+    # params stay fp32 under the policy
+    assert jax.tree.leaves(state.params)[0].dtype == jnp.float32
+    mesh = make_mesh(1)
+    step = make_train_step(model, tx, cfg, mesh)
+    batch = shard_batch(mesh, synthetic_batch(), spatial_dims=[1] * 5)
+    state, losses = step(state, *batch)
+    assert losses["total"].dtype == jnp.float32
+    assert np.isfinite(float(losses["total"]))
