@@ -32,12 +32,16 @@ def main() -> None:
 
     cfg = Config(num_stack=1, hourglass_inch=128, num_cls=2, topk=100,
                  conf_th=0.0, nms_th=0.5, imsize=IMSIZE)
+    from real_time_helmet_detection_tpu.train import init_variables
+
     model = build_model(cfg)
     rng = jax.random.key(0)
     images = jnp.asarray(
         np.random.default_rng(0).standard_normal(
             (BATCH, IMSIZE, IMSIZE, 3)).astype(np.float32))
-    variables = model.init(rng, images[:1], train=False)
+    # jitted init: eager init over the remote-TPU tunnel is minutes-slow
+    params, batch_stats = init_variables(model, rng, IMSIZE)
+    variables = {"params": params, "batch_stats": batch_stats}
     predict = make_predict_fn(model, cfg)
 
     for _ in range(WARMUP):

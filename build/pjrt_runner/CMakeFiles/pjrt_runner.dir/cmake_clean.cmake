@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/pjrt_runner.dir/runner.cc.o"
+  "CMakeFiles/pjrt_runner.dir/runner.cc.o.d"
+  "pjrt_runner"
+  "pjrt_runner.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/pjrt_runner.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -125,6 +125,7 @@ class Config:
     # log
     print_interval: int = 100
     save_path: str = "./WEIGHTS/"
+    profile: bool = False         # jax.profiler trace of early train steps
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -33,24 +33,24 @@ from .data import (CLASS2COLOR, INDEX2CLASS, BatchLoader, TestAugmentor,
                    VOCDataset, load_dataset)
 from .models import build_model
 from .predict import make_predict_fn
-from .train import TrainState, create_train_state, restore_params_only
-from .optim import build_optimizer
+from .train import init_variables, restore_variables
 from .utils import (AverageMeter, draw_box, imload, save_pickle, timestamp,
                     write_text)
 
 
 def load_eval_state(cfg: Config) -> Tuple:
     """Build model + restore weights for inference (≡ ref evaluate.py:20,
-    train.py:164-193 eval path). Returns (model, variables)."""
+    train.py:164-193 eval path). Returns (model, variables). No optimizer
+    state is ever built — eval shouldn't spend 2x model params of device
+    memory on Adam moments it discards."""
     model = build_model(cfg)
     imsize = cfg.imsize or 512
-    tx = build_optimizer(cfg, steps_per_epoch=1)
-    state = create_train_state(model, cfg, jax.random.key(cfg.random_seed),
-                               imsize, tx)
+    params, batch_stats = init_variables(model, jax.random.key(cfg.random_seed),
+                                         imsize)
     if cfg.model_load:
-        state = restore_params_only(cfg.model_load, state)
-    variables = {"params": state.params, "batch_stats": state.batch_stats}
-    return model, variables
+        params, batch_stats = restore_variables(cfg.model_load, params,
+                                                batch_stats)
+    return model, {"params": params, "batch_stats": batch_stats}
 
 
 def _origin_size(voc_dict: Dict) -> Tuple[int, int]:

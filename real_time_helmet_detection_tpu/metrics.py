@@ -58,11 +58,16 @@ def compute_class_ap(gt: Mapping[str, np.ndarray],
       detections: list of (image_id, score, box(4,)) for this class.
       iou_th: match threshold.
 
-    Returns (ap, num_gt).
+    Returns (ap, num_gt). A class absent from the ground truth returns NaN
+    (excluded from mAP) even if it has detections — the mAP tool iterates
+    GT classes only, so stray false positives of a GT-less class must not
+    drag the mean down.
     """
     num_gt = sum(len(b) for b in gt.values())
+    if num_gt == 0:
+        return float("nan"), 0
     if not detections:
-        return (0.0 if num_gt else float("nan")), num_gt
+        return 0.0, num_gt
 
     matched = {img: np.zeros(len(b), bool) for img, b in gt.items()}
     dets = sorted(detections, key=lambda d: -d[1])

@@ -21,7 +21,13 @@ import optax
 
 
 def make_lr_schedule(cfg, steps_per_epoch: int) -> optax.Schedule:
-    """MultiStepLR equivalent: lr * gamma^k after each milestone epoch."""
+    """MultiStepLR equivalent: lr * gamma^k after each milestone epoch.
+
+    `steps_per_epoch` must be in *schedule-count* steps: under
+    `optax.MultiSteps` the inner optimizer's count only advances on every
+    k-th (emit) micro-step, so the caller divides by `sub_divisions`
+    (build_optimizer does this) — otherwise milestones fire k times too
+    late."""
     boundaries = {int(m) * steps_per_epoch: cfg.lr_gamma
                   for m in cfg.lr_milestone if int(m) > 0}
     return optax.piecewise_constant_schedule(cfg.lr, boundaries)
@@ -29,7 +35,8 @@ def make_lr_schedule(cfg, steps_per_epoch: int) -> optax.Schedule:
 
 def build_optimizer(cfg, steps_per_epoch: int) -> optax.GradientTransformation:
     """Construct the optax transformation from config flags."""
-    schedule = make_lr_schedule(cfg, steps_per_epoch)
+    updates_per_epoch = max(1, steps_per_epoch // max(1, cfg.sub_divisions))
+    schedule = make_lr_schedule(cfg, updates_per_epoch)
     name = cfg.optim.lower()
     if name == "adam":
         tx = optax.adam(schedule)

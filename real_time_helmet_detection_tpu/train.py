@@ -69,17 +69,21 @@ def split_stack_predictions(out: jax.Array, num_cls: int,
     return heat, offset, size
 
 
+def init_variables(model, rng: jax.Array, imsize: int):
+    """Initialize (params, batch_stats) — no optimizer. The init is jitted:
+    eager init would run each conv as its own dispatch, painfully slow over
+    a remote-TPU tunnel."""
+    dummy = jnp.zeros((1, imsize, imsize, 3), jnp.float32)
+    variables = jax.jit(model.init, static_argnames=("train",))(
+        rng, dummy, train=False)
+    return variables["params"], variables.get("batch_stats", {})
+
+
 def create_train_state(model, cfg: Config, rng: jax.Array, imsize: int,
                        tx: optax.GradientTransformation) -> TrainState:
     """Initialize params/batch-stats/optimizer (≡ ref train.py:164-187
     `load_network` fresh path)."""
-    dummy = jnp.zeros((1, imsize, imsize, 3), jnp.float32)
-    # jit the init: eager init would run each conv as its own dispatch,
-    # painfully slow over a remote-TPU tunnel
-    variables = jax.jit(model.init, static_argnames=("train",))(
-        rng, dummy, train=False)
-    params = variables["params"]
-    batch_stats = variables.get("batch_stats", {})
+    params, batch_stats = init_variables(model, rng, imsize)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                       batch_stats=batch_stats, opt_state=tx.init(params))
 
@@ -201,29 +205,54 @@ def load_checkpoint(path: str, state: TrainState):
     return st, int(raw_ckpt["epoch"]), _read_loss_log(path)
 
 
-def restore_params_only(path: str, state: TrainState) -> TrainState:
-    """Eval-time weight restore: params + batch_stats, no optimizer
+def restore_variables(path: str, params_template, batch_stats_template):
+    """Eval-time weight restore: (params, batch_stats), no optimizer
     (≡ ref train.py:191-193 when not training). Works regardless of the
-    optimizer the checkpoint was trained with."""
+    optimizer the checkpoint was trained with; the templates supply the
+    pytree structure only."""
     restored = _restore_raw(path)["state"]
-    params = jax.tree.unflatten(jax.tree.structure(state.params),
+    params = jax.tree.unflatten(jax.tree.structure(params_template),
                                 jax.tree.leaves(restored["params"]))
-    batch_stats = jax.tree.unflatten(jax.tree.structure(state.batch_stats),
-                                     jax.tree.leaves(restored["batch_stats"]))
+    batch_stats = jax.tree.unflatten(
+        jax.tree.structure(batch_stats_template),
+        jax.tree.leaves(restored["batch_stats"]))
+    return params, batch_stats
+
+
+def restore_params_only(path: str, state: TrainState) -> TrainState:
+    """`restore_variables` for TrainState holders."""
+    params, batch_stats = restore_variables(path, state.params,
+                                            state.batch_stats)
     return state.replace(params=params, batch_stats=batch_stats)
+
+
+def make_snapshot_fn(model, cfg: Config):
+    """Jitted first-stack sigmoid heatmap for the training-log blends
+    (≡ ref train.py:154-158's prediction snapshots)."""
+    @jax.jit
+    def snapshot(params, batch_stats, images):
+        out = model.apply({"params": params, "batch_stats": batch_stats},
+                          images, train=False)
+        return jax.nn.sigmoid(out[:, 0, ..., :cfg.num_cls])
+    return snapshot
 
 
 def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, train_step,
                 state: TrainState, mesh, loss_log: LossLog,
-                is_chief: bool = True) -> TrainState:
+                is_chief: bool = True, snapshot_fn=None) -> TrainState:
     """One epoch of the hot loop (≡ ref train.py:86-162 `train_step`)."""
     meters = {k: AverageMeter() for k in ("data", "step")}
     loader.set_epoch(epoch)
+    profiling = False
     tic = time.time()
-    last_batch = None
     for i, batch in enumerate(loader):
         data_t = time.time() - tic
         meters["data"].update(data_t)
+
+        if cfg.profile and is_chief and epoch == 0 and i == 2:
+            # steps 0-1 include compiles; trace a few steady-state steps
+            jax.profiler.start_trace(os.path.join(cfg.save_path, "trace"))
+            profiling = True
 
         # host->device: local shard -> global sharded arrays (multi-host
         # assembles the global batch; ≡ ref .to(device), train.py:99)
@@ -234,7 +263,12 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, train_step,
         losses = jax.device_get(losses)
         loss_log.append(losses)
         meters["step"].update(time.time() - tic - data_t)
-        last_batch = batch
+
+        if profiling and i >= 7:
+            jax.profiler.stop_trace()
+            profiling = False
+            print("%s: profiler trace -> %s" % (
+                timestamp(), os.path.join(cfg.save_path, "trace")), flush=True)
 
         if is_chief and (i % cfg.print_interval == 0):
             print("%s: epoch %d iter %d/%d, %s | data %.3fs step %.3fs"
@@ -242,12 +276,17 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, train_step,
                      loss_log.get_log(length=cfg.print_interval),
                      meters["data"].avg, meters["step"].avg), flush=True)
             snapshot_dir = os.path.join(cfg.save_path, "training_log")
-            if os.path.isdir(snapshot_dir) and last_batch is not None:
-                blend_heatmap(last_batch.image, last_batch.heatmap,
-                              cfg.pretrained).save(
-                    os.path.join(snapshot_dir,
-                                 f"e{epoch}_i{i}_gt.png"))
+            if os.path.isdir(snapshot_dir):
+                blend_heatmap(batch.image, batch.heatmap, cfg.pretrained).save(
+                    os.path.join(snapshot_dir, f"e{epoch}_i{i}_gt.png"))
+                if snapshot_fn is not None:
+                    pred = jax.device_get(snapshot_fn(
+                        state.params, state.batch_stats, arrays[0]))
+                    blend_heatmap(batch.image, pred, cfg.pretrained).save(
+                        os.path.join(snapshot_dir, f"e{epoch}_i{i}_pred.png"))
         tic = time.time()
+    if profiling:  # short epoch: close the trace cleanly
+        jax.profiler.stop_trace()
     return state
 
 
@@ -290,6 +329,7 @@ def train(cfg: Config) -> TrainState:
                   % (timestamp(), cfg.model_load, ckpt_epoch), flush=True)
 
     step_fn = make_train_step(model, tx, cfg, mesh)
+    snapshot_fn = make_snapshot_fn(model, cfg) if is_chief else None
     if is_chief:
         nparams = sum(x.size for x in jax.tree.leaves(state.params))
         print("%s: model built, %d params, mesh %s" % (
@@ -297,7 +337,7 @@ def train(cfg: Config) -> TrainState:
 
     for epoch in range(start_epoch, cfg.end_epoch):
         state = train_epoch(cfg, epoch, loader, step_fn, state, mesh,
-                            loss_log, is_chief)
+                            loss_log, is_chief, snapshot_fn)
         if is_chief:
             path = save_checkpoint(cfg.save_path, epoch, state, loss_log)
             print("%s: epoch %d checkpoint -> %s" % (timestamp(), epoch, path),

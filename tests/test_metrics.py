@@ -68,6 +68,19 @@ def test_compute_map_two_classes():
     assert m["num_gt"] == {0: 1, 1: 1}
 
 
+def test_zero_gt_class_excluded_even_with_detections():
+    """Cartucho-mAP semantics: a class with no GT anywhere is excluded from
+    the mean even when stray detections of it exist."""
+    gt_boxes = {"a": np.array([[0, 0, 10, 10]], np.float32)}
+    gt_labels = {"a": np.array([0])}
+    det_boxes = {"a": np.array([[0, 0, 10, 10], [50, 50, 60, 60]], np.float32)}
+    det_labels = {"a": np.array([0, 1])}  # class 1 has no GT
+    det_scores = {"a": np.array([0.9, 0.3])}
+    m = compute_map(gt_boxes, gt_labels, det_boxes, det_labels, det_scores)
+    assert np.isnan(m["ap"][1])
+    assert m["map"] == pytest.approx(1.0)
+
+
 def test_txt_roundtrip_and_scoring(tmp_path):
     boxes = np.array([[1.5, 2.5, 30.0, 40.0]], np.float32)
     labels = np.array([1])
