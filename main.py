@@ -10,6 +10,7 @@ Usage:
   python main.py --train-flag --data ./DATA/VOC2028 --batch-size 16 --amp
   python main.py --model-load ./WEIGHTS/check_point_100 --data ./DATA/VOC2028 --imsize 512
   python main.py --model-load ./WEIGHTS/check_point_100 --data image.jpg --imsize 512
+  python main.py --model-load ./WEIGHTS/check_point_100 --export-flag --imsize 512
 """
 
 import os
@@ -24,6 +25,10 @@ def main() -> None:
     if cfg.train_flag:
         from real_time_helmet_detection_tpu.train import train
         train(cfg)
+    elif cfg.export_flag:
+        from real_time_helmet_detection_tpu.export import export_predict
+        paths = export_predict(cfg)
+        print("exported:", *paths)
     elif cfg.data is not None and os.path.isfile(cfg.data):
         from real_time_helmet_detection_tpu.evaluate import demo
         demo(cfg)

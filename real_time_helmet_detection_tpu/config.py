@@ -72,7 +72,8 @@ class Config:
     dist_backend: str = "xla"     # accepted for CLI parity; always XLA
     dist_url: str = "tcp://localhost:29500"  # jax.distributed coordinator
 
-    # evaluation and demo
+    # evaluation, demo, export
+    export_flag: bool = False     # export the fused predict fn and exit
     imsize: Optional[int] = None
     topk: int = 100
     conf_th: float = 0.0

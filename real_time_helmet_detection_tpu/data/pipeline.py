@@ -29,6 +29,7 @@ from typing import Iterator, List, Optional, Sequence
 import numpy as np
 
 from ..ops.encode import encode_boxes
+from ..ops.encode_native import encode_boxes_batch_native
 from ..utils import normalize_image
 
 
@@ -63,20 +64,29 @@ def collate(samples: Sequence, augmentor, pretrained: str = "imagenet",
     imgs, boxes, labels = augmentor(list(imgs), list(boxes), list(labels))
 
     size = imgs[0].shape[0]  # square; shared across the batch
-    heat, off, wh, mask, pb, pl, pv = [], [], [], [], [], [], []
-    for b, l in zip(boxes, labels):
-        h, o, w, m = encode_boxes(b, l, (size, size), scale_factor, num_cls,
-                                  normalized_coord)
-        heat.append(h); off.append(o); wh.append(w); mask.append(m)
-        bb, ll, vv = pad_boxes(b, l, max_boxes)
-        pb.append(bb); pl.append(ll); pv.append(vv)
+    pb, pl, pv = zip(*(pad_boxes(b, l, max_boxes)
+                       for b, l in zip(boxes, labels)))
+    pb, pl, pv = np.stack(pb), np.stack(pl), np.stack(pv)
+
+    # native C++ encoder (one call for the whole batch) when built;
+    # identical-semantics numpy fallback otherwise
+    counts = pv.sum(axis=1).astype(np.int32)
+    out = encode_boxes_batch_native(pb, pl, counts, (size, size),
+                                    scale_factor, num_cls, normalized_coord)
+    if out is not None:
+        heat, off, wh, mask = out
+    else:
+        # same truncated-to-max_boxes set as the native path, so both
+        # backends produce identical targets
+        per = [encode_boxes(pb[i, :counts[i]], pl[i, :counts[i]],
+                            (size, size), scale_factor, num_cls,
+                            normalized_coord)
+               for i in range(len(pb))]
+        heat, off, wh, mask = (np.stack(x) for x in zip(*per))
 
     image = np.stack([normalize_image(im, pretrained) for im in imgs])
-    return Batch(image=image.astype(np.float32),
-                 heatmap=np.stack(heat), offset=np.stack(off),
-                 wh=np.stack(wh), mask=np.stack(mask),
-                 boxes=np.stack(pb), labels=np.stack(pl), valid=np.stack(pv),
-                 infos=list(infos))
+    return Batch(image=image, heatmap=heat, offset=off, wh=wh, mask=mask,
+                 boxes=pb, labels=pl, valid=pv, infos=list(infos))
 
 
 class BatchLoader:

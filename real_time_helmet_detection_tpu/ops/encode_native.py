@@ -1,0 +1,134 @@
+"""ctypes binding for the native C++ GT encoder (cpp/hostops/encode.cc).
+
+The TPU-native framework's answer to the reference's native input path
+(imgaug's C-accelerated numpy + torch DataLoader worker processes,
+SURVEY.md §2.2): the per-box Gaussian splat runs as tight C loops over each
+box's support window — O(sum window areas) instead of the vectorized numpy
+broadcast's O(N*H*W) — keeping host-side collate off the critical path of
+short TPU steps.
+
+The shared library builds on demand with the baked-in g++ (no Python
+headers needed — plain C ABI), is cached under build/, and everything
+degrades gracefully to the numpy encoder when a toolchain is unavailable.
+Exact-semantics parity with `encode.encode_boxes` is pinned by
+tests/test_encode_native.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+_SRC = os.path.join(_REPO_ROOT, "cpp", "hostops", "encode.cc")
+_LIB = os.path.join(_REPO_ROOT, "build", "hostops", "libhostops.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        src_newer = (not os.path.exists(_LIB)
+                     or (os.path.exists(_SRC)
+                         and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)))
+        if src_newer and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.encode_boxes_f32.argtypes = [
+            f32p, i32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_float, ctypes.c_int32, ctypes.c_int32,
+            f32p, f32p, f32p, f32p]
+        lib.encode_boxes_f32.restype = None
+        lib.encode_boxes_batch_f32.argtypes = [
+            f32p, i32p, i32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_float, ctypes.c_int32, ctypes.c_int32,
+            f32p, f32p, f32p, f32p]
+        lib.encode_boxes_batch_f32.restype = None
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def encode_boxes_native(boxes, labels, imsize, scale_factor: int = 4,
+                        num_cls: int = 2, normalized: bool = False
+                        ) -> Optional[Tuple[np.ndarray, ...]]:
+    """Drop-in for `encode.encode_boxes`; returns None if the native lib is
+    unavailable (caller falls back to numpy)."""
+    lib = _load()
+    if lib is None:
+        return None
+    width = int(imsize[0]) // scale_factor
+    height = int(imsize[1]) // scale_factor
+    heat = np.zeros((height, width, num_cls), np.float32)
+    offset = np.zeros((height, width, 2), np.float32)
+    size = np.zeros((height, width, 2), np.float32)
+    mask = np.zeros((height, width, 1), np.float32)
+    n = 0 if boxes is None else len(boxes)
+    if n:
+        b = np.ascontiguousarray(np.asarray(boxes, np.float32).reshape(-1, 4))
+        l = np.ascontiguousarray(np.asarray(labels, np.int32).reshape(-1))
+        lib.encode_boxes_f32(b, l, n, width, height, float(scale_factor),
+                             num_cls, int(normalized), heat, offset, size,
+                             mask)
+    return heat, offset, size, mask
+
+
+def encode_boxes_batch_native(boxes: np.ndarray, labels: np.ndarray,
+                              counts: np.ndarray, imsize,
+                              scale_factor: int = 4, num_cls: int = 2,
+                              normalized: bool = False
+                              ) -> Optional[Tuple[np.ndarray, ...]]:
+    """Whole-batch encode in ONE native call (amortizes ctypes overhead
+    across the collate). boxes (B, max_boxes, 4) padded, labels
+    (B, max_boxes), counts (B,) valid-box counts. Returns None if the
+    native lib is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    batch, max_boxes = labels.shape
+    width = int(imsize[0]) // scale_factor
+    height = int(imsize[1]) // scale_factor
+    heat = np.zeros((batch, height, width, num_cls), np.float32)
+    offset = np.zeros((batch, height, width, 2), np.float32)
+    size = np.zeros((batch, height, width, 2), np.float32)
+    mask = np.zeros((batch, height, width, 1), np.float32)
+    lib.encode_boxes_batch_f32(
+        np.ascontiguousarray(boxes, dtype=np.float32),
+        np.ascontiguousarray(labels, dtype=np.int32),
+        np.ascontiguousarray(counts, dtype=np.int32),
+        batch, max_boxes, width, height, float(scale_factor), num_cls,
+        int(normalized), heat, offset, size, mask)
+    return heat, offset, size, mask

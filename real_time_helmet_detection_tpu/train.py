@@ -239,7 +239,8 @@ def make_snapshot_fn(model, cfg: Config):
 
 def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, train_step,
                 state: TrainState, mesh, loss_log: LossLog,
-                is_chief: bool = True, snapshot_fn=None) -> TrainState:
+                is_chief: bool = True, snapshot_fn=None,
+                profile_this_epoch: bool = False) -> TrainState:
     """One epoch of the hot loop (≡ ref train.py:86-162 `train_step`)."""
     meters = {k: AverageMeter() for k in ("data", "step")}
     loader.set_epoch(epoch)
@@ -249,7 +250,7 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, train_step,
         data_t = time.time() - tic
         meters["data"].update(data_t)
 
-        if cfg.profile and is_chief and epoch == 0 and i == 2:
+        if profile_this_epoch and is_chief and i == 2:
             # steps 0-1 include compiles; trace a few steady-state steps
             jax.profiler.start_trace(os.path.join(cfg.save_path, "trace"))
             profiling = True
@@ -279,7 +280,10 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, train_step,
             if os.path.isdir(snapshot_dir):
                 blend_heatmap(batch.image, batch.heatmap, cfg.pretrained).save(
                     os.path.join(snapshot_dir, f"e{epoch}_i{i}_gt.png"))
-                if snapshot_fn is not None:
+                # single-host only: with multiple processes the snapshot
+                # output spans non-addressable devices (device_get would
+                # raise) and the global batch != the local batch.image
+                if snapshot_fn is not None and jax.process_count() == 1:
                     pred = jax.device_get(snapshot_fn(
                         state.params, state.batch_stats, arrays[0]))
                     blend_heatmap(batch.image, pred, cfg.pretrained).save(
@@ -337,7 +341,9 @@ def train(cfg: Config) -> TrainState:
 
     for epoch in range(start_epoch, cfg.end_epoch):
         state = train_epoch(cfg, epoch, loader, step_fn, state, mesh,
-                            loss_log, is_chief, snapshot_fn)
+                            loss_log, is_chief, snapshot_fn,
+                            profile_this_epoch=(cfg.profile
+                                                and epoch == start_epoch))
         if is_chief:
             path = save_checkpoint(cfg.save_path, epoch, state, loss_log)
             print("%s: epoch %d checkpoint -> %s" % (timestamp(), epoch, path),
