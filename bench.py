@@ -34,7 +34,11 @@ def main() -> None:
                  conf_th=0.0, nms_th=0.5, imsize=IMSIZE)
     from real_time_helmet_detection_tpu.train import init_variables
 
-    model = build_model(cfg)
+    # bf16 compute is the deployment fast path on TPU (params fp32, decode
+    # fp32); BENCH_DTYPE=fp32 benches the reference-comparable fp32 path.
+    import os
+    dtype = None if os.environ.get("BENCH_DTYPE") == "fp32" else jnp.bfloat16
+    model = build_model(cfg, dtype=dtype)
     rng = jax.random.key(0)
     images = jnp.asarray(
         np.random.default_rng(0).standard_normal(
@@ -55,6 +59,8 @@ def main() -> None:
     fps = BATCH * ITERS / dt
     print(json.dumps({"metric": "inference_fps_512",
                       "value": round(fps, 2), "unit": "img/s",
+                      "dtype": "float32" if dtype is None else "bfloat16",
+                      "batch": BATCH,
                       "vs_baseline": round(fps / BASELINE_FPS, 3)}))
 
 

@@ -90,6 +90,7 @@ class Config:
     affine_scale: List[float] = field(default_factory=lambda: [0.5, 1.5])
     multiscale_flag: bool = False
     multiscale: List[int] = field(default_factory=lambda: [320, 512, 64])
+    device_augment: bool = False  # augment+encode on the TPU inside the step
 
     # loss
     hm_weight: float = 1.0

@@ -1,0 +1,183 @@
+"""On-device (jit-able) batched augmentation with box tracking.
+
+The TPU-native replacement for the reference's imgaug host pipeline
+(/root/reference/data.py:127-161: Multiply -> Affine -> Crop -> Fliplr ->
+multiscale Resize with box re-projection — SURVEY.md §2.2 "device-side
+augmentation"): the whole batch augments as ONE XLA program on the
+accelerator, composing with the on-device GT encoder (`ops.encode_boxes_jax`)
+so the host only decodes JPEGs and resizes to a fixed canvas.
+
+Design mirrors the host augmentor (`augment.py`) exactly — the same single
+3x3 matrix composition (affine ∘ crop ∘ flip ∘ resize) applied once to the
+pixels and exactly to the boxes — but vectorized over the batch with
+`vmap`, sampled from a `jax.random` key (explicit, reproducible, SPMD-safe)
+instead of a numpy Generator:
+
+  * images warp by the INVERSE matrix via bilinear gather (the jnp analogue
+    of PIL's Image.AFFINE semantics; out-of-image samples are zero);
+  * boxes map through the FORWARD matrix (corner transform -> axis-aligned
+    envelope), then fully-outside boxes are mask-dropped and the rest
+    clipped — `filter_boxes` semantics with a validity mask instead of a
+    data-dependent shape;
+  * color multiply and normalization fuse into the same program.
+
+Output canvas size is static per jit cache entry; per-batch multiscale uses
+the same bucket-grid trick as the host path (one compile per size).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _translation(tx, ty):
+    m = jnp.eye(3, dtype=jnp.float32)
+    return m.at[0, 2].set(tx).at[1, 2].set(ty)
+
+
+def _scaling(sx, sy):
+    return jnp.diag(jnp.stack([sx, sy, jnp.float32(1.0)]))
+
+
+def sample_params(key: jax.Array, batch: int, *, crop_percent=(0.0, 0.1),
+                  color_multiply=(1.2, 1.5), translate_percent: float = 0.1,
+                  affine_scale=(0.5, 1.5)) -> Dict[str, jax.Array]:
+    """Per-image augmentation parameters (same distributions as
+    `TrainAugmentor`, ref data.py:136-147)."""
+    ks = jax.random.split(key, 5)
+    u = lambda k, lo, hi, shape=(batch,): jax.random.uniform(
+        k, shape, jnp.float32, lo, hi)
+    return {
+        "scale": u(ks[0], *affine_scale),
+        "translate": u(ks[1], -translate_percent, translate_percent,
+                       (batch, 2)),
+        "crop": u(ks[2], crop_percent[0], crop_percent[1], (batch, 4)),
+        "flip": jax.random.bernoulli(ks[3], 0.5, (batch,)),
+        "color": u(ks[4], *color_multiply),
+    }
+
+
+def build_matrix(params: Dict[str, jax.Array], w: float, h: float,
+                 target: float) -> jax.Array:
+    """Forward 3x3 matrix for one image (same composition as
+    `TrainAugmentor._sample_matrix`)."""
+    s = params["scale"]
+    tx = params["translate"][0] * w
+    ty = params["translate"][1] * h
+    top, right, bottom, left = (params["crop"][i] for i in range(4))
+    affine = (_translation(w / 2 + tx, h / 2 + ty)
+              @ _scaling(s, s)
+              @ _translation(-w / 2, -h / 2))
+    cw = jnp.maximum(w * (1.0 - left - right), 1.0)
+    ch = jnp.maximum(h * (1.0 - top - bottom), 1.0)
+    crop = _scaling(w / cw, h / ch) @ _translation(-left * w, -top * h)
+    m = crop @ affine
+    flip_m = _translation(jnp.float32(w), 0.0) @ _scaling(jnp.float32(-1.0),
+                                                          jnp.float32(1.0))
+    m = jnp.where(params["flip"], flip_m @ m, m)
+    return _scaling(jnp.float32(target / w), jnp.float32(target / h)) @ m
+
+
+def warp_image(image: jax.Array, forward: jax.Array, target: int) -> jax.Array:
+    """Bilinear warp of one (H, W, C) image by the forward matrix into
+    (target, target, C); out-of-image samples are 0 (PIL AFFINE fill)."""
+    inv = jnp.linalg.inv(forward)
+    ys, xs = jnp.meshgrid(jnp.arange(target, dtype=jnp.float32),
+                          jnp.arange(target, dtype=jnp.float32),
+                          indexing="ij")
+    # pixel centers, like PIL's transform sampling
+    ones = jnp.ones_like(xs)
+    src = jnp.einsum("ij,jhw->ihw",
+                     inv, jnp.stack([xs + 0.5, ys + 0.5, ones]))
+    sx, sy = src[0] - 0.5, src[1] - 0.5
+
+    h, w, _ = image.shape
+    x0 = jnp.floor(sx)
+    y0 = jnp.floor(sy)
+    fx, fy = sx - x0, sy - y0
+
+    def gather(yi, xi):
+        inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        return jnp.where(inside[..., None], image[yc, xc, :], 0.0)
+
+    out = ((1 - fx)[..., None] * (1 - fy)[..., None] * gather(y0, x0)
+           + fx[..., None] * (1 - fy)[..., None] * gather(y0, x0 + 1)
+           + (1 - fx)[..., None] * fy[..., None] * gather(y0 + 1, x0)
+           + fx[..., None] * fy[..., None] * gather(y0 + 1, x0 + 1))
+    return out
+
+
+def transform_boxes_jax(boxes: jax.Array, m: jax.Array) -> jax.Array:
+    """(N, 4) xyxy through a 3x3 matrix -> axis-aligned envelope (the jnp
+    twin of `augment.transform_boxes`)."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    corners = jnp.stack([
+        jnp.stack([x1, y1], -1), jnp.stack([x2, y1], -1),
+        jnp.stack([x2, y2], -1), jnp.stack([x1, y2], -1)], axis=1)  # (N,4,2)
+    ones = jnp.ones((*corners.shape[:2], 1), corners.dtype)
+    pts = jnp.concatenate([corners, ones], -1) @ m.T
+    xy = pts[..., :2] / pts[..., 2:3]
+    return jnp.concatenate([xy.min(axis=1), xy.max(axis=1)], axis=-1)
+
+
+def filter_boxes_jax(boxes: jax.Array, valid: jax.Array,
+                     size: float) -> Tuple[jax.Array, jax.Array]:
+    """Mask-drop fully-outside boxes, clip the rest (`filter_boxes`
+    semantics with fixed shapes)."""
+    keep = ((boxes[:, 2] > 0) & (boxes[:, 0] < size)
+            & (boxes[:, 3] > 0) & (boxes[:, 1] < size))
+    clipped = jnp.clip(boxes, 0.0, size)
+    nonzero = (clipped[:, 2] > clipped[:, 0]) & (clipped[:, 3] > clipped[:, 1])
+    return clipped, valid & keep & nonzero
+
+
+@partial(jax.jit, static_argnames=("target", "scale_factor", "num_cls",
+                                   "normalized"))
+def augment_encode_batch(key: jax.Array, images: jax.Array, boxes: jax.Array,
+                         labels: jax.Array, valid: jax.Array, *, target: int,
+                         scale_factor: int = 4, num_cls: int = 2,
+                         normalized: bool = False,
+                         crop_percent=(0.0, 0.1), color_multiply=(1.2, 1.5),
+                         translate_percent: float = 0.1,
+                         affine_scale=(0.5, 1.5)):
+    """Full on-device train input path: augment + GT-encode one batch.
+
+    Args:
+      key: PRNG key (fold in the step index for per-step randomness).
+      images: (B, H, W, 3) float32 in [0, 255] — the host canvas.
+      boxes: (B, N, 4) padded xyxy at canvas scale; labels (B, N) int32;
+        valid (B, N) bool.
+      target: output canvas size (static; multiscale = bucketed recompiles).
+
+    Returns (images (B, target, target, 3) in [0, 255], heat, offset, size,
+    mask, boxes, valid) — maps channels-last at target//scale_factor.
+    """
+    from ..ops.encode import encode_boxes_jax
+
+    b, h, w, _ = images.shape
+    params = sample_params(key, b, crop_percent=tuple(crop_percent),
+                           color_multiply=tuple(color_multiply),
+                           translate_percent=translate_percent,
+                           affine_scale=tuple(affine_scale))
+
+    def one(i):
+        p = {k: v[i] for k, v in params.items()}
+        m = build_matrix(p, float(w), float(h), float(target))
+        img = jnp.clip(images[i] * p["color"], 0.0, 255.0)
+        # re-clip after the warp: bilinear weights can overshoot by an ulp
+        img = jnp.clip(warp_image(img, m, target), 0.0, 255.0)
+        bx = transform_boxes_jax(boxes[i], m)
+        bx, vd = filter_boxes_jax(bx, valid[i], float(target))
+        heat, off, size, mask = encode_boxes_jax(
+            bx, labels[i], vd, height=target // scale_factor,
+            width=target // scale_factor, scale_factor=scale_factor,
+            num_cls=num_cls, normalized=normalized)
+        return img, heat, off, size, mask, bx, vd
+
+    return jax.vmap(one)(jnp.arange(b))

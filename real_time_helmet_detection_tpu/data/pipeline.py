@@ -58,8 +58,15 @@ def pad_boxes(boxes: np.ndarray, labels: np.ndarray, max_boxes: int):
 
 def collate(samples: Sequence, augmentor, pretrained: str = "imagenet",
             num_cls: int = 2, normalized_coord: bool = False,
-            scale_factor: int = 4, max_boxes: int = 128) -> Batch:
-    """samples: list of (img, boxes, labels, voc_dict) from `VOCDataset`."""
+            scale_factor: int = 4, max_boxes: int = 128,
+            raw: bool = False) -> Batch:
+    """samples: list of (img, boxes, labels, voc_dict) from `VOCDataset`.
+
+    `raw=True` is the device-augment input mode: images stay un-normalized
+    float32 [0, 255] and no target maps are encoded — augmentation, GT
+    encoding and normalization all happen on the accelerator inside the
+    train step (data/augment_device.py).
+    """
     imgs, boxes, labels, infos = zip(*samples)
     imgs, boxes, labels = augmentor(list(imgs), list(boxes), list(labels))
 
@@ -67,6 +74,12 @@ def collate(samples: Sequence, augmentor, pretrained: str = "imagenet",
     pb, pl, pv = zip(*(pad_boxes(b, l, max_boxes)
                        for b, l in zip(boxes, labels)))
     pb, pl, pv = np.stack(pb), np.stack(pl), np.stack(pv)
+
+    if raw:
+        empty = np.zeros((len(imgs), 0, 0, 0), np.float32)
+        return Batch(image=np.stack(imgs).astype(np.float32), heatmap=empty,
+                     offset=empty, wh=empty, mask=empty, boxes=pb, labels=pl,
+                     valid=pv, infos=list(infos))
 
     # native C++ encoder (one call for the whole batch) when built;
     # identical-semantics numpy fallback otherwise
@@ -102,13 +115,15 @@ class BatchLoader:
                  normalized_coord: bool = False, scale_factor: int = 4,
                  max_boxes: int = 128, shuffle: bool = True,
                  drop_last: bool = True, rank: int = 0, world_size: int = 1,
-                 seed: int = 777, num_workers: int = 4, prefetch: int = 2):
+                 seed: int = 777, num_workers: int = 4, prefetch: int = 2,
+                 raw: bool = False):
         self.dataset = dataset
         self.augmentor = augmentor
         self.batch_size = batch_size
         self.kw = dict(pretrained=pretrained, num_cls=num_cls,
                        normalized_coord=normalized_coord,
-                       scale_factor=scale_factor, max_boxes=max_boxes)
+                       scale_factor=scale_factor, max_boxes=max_boxes,
+                       raw=raw)
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.rank, self.world_size = rank, world_size

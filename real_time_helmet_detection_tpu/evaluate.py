@@ -43,7 +43,10 @@ def load_eval_state(cfg: Config) -> Tuple:
     train.py:164-193 eval path). Returns (model, variables). No optimizer
     state is ever built — eval shouldn't spend 2x model params of device
     memory on Adam moments it discards."""
-    model = build_model(cfg)
+    # --amp selects bf16 compute for inference too (params stay fp32, the
+    # checkpoint format is identical): the TPU-idiomatic fast path.
+    dtype = jnp.bfloat16 if cfg.amp else None
+    model = build_model(cfg, dtype=dtype)
     imsize = cfg.imsize or 512
     params, batch_stats = init_variables(model, jax.random.key(cfg.random_seed),
                                          imsize)

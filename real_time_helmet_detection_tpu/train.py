@@ -140,6 +140,48 @@ def make_train_step(model, tx, cfg: Config, mesh):
         donate_argnums=(0,))
 
 
+def make_device_train_step(model, tx, cfg: Config, mesh, target: int):
+    """Train step with the input pipeline fused in: on-device augmentation,
+    GT encoding and normalization followed by fwd/bwd/update — ONE XLA
+    program per multiscale bucket. The host only decodes JPEGs and resizes
+    to the canvas (data/augment_device.py; ≡ imgaug + box2hm + normalize of
+    ref data.py:93-125 moved onto the accelerator)."""
+    from .data.augment_device import augment_encode_batch
+    from .utils import normalizer_stats
+
+    mean, std = normalizer_stats(cfg.pretrained)
+    mean = jnp.asarray(mean)
+    std = jnp.asarray(std)
+
+    def step(state: TrainState, key, images, boxes, labels, valid):
+        img, heat, off, wh, mask, _, _ = augment_encode_batch(
+            key, images, boxes, labels, valid, target=target,
+            scale_factor=cfg.scale_factor, num_cls=cfg.num_cls,
+            normalized=cfg.normalized_coord,
+            crop_percent=tuple(cfg.crop_percent),
+            color_multiply=tuple(cfg.color_multiply),
+            translate_percent=cfg.translate_percent,
+            affine_scale=tuple(cfg.affine_scale))
+        img = (img / 255.0 - mean) / std
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (_, (batch_stats, losses)), grads = grad_fn(
+            state.params, state.batch_stats, model, img, heat, off, wh, mask,
+            cfg)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(step=state.step + 1, params=params,
+                             batch_stats=batch_stats,
+                             opt_state=opt_state), losses
+
+    repl = replicated(mesh)
+    img_sh = batch_sharding(mesh, 4)     # gather-based warp: no spatial shard
+    box_sh = batch_sharding(mesh, 3)
+    lab_sh = batch_sharding(mesh, 2)
+    return jax.jit(step,
+                   in_shardings=(repl, repl, img_sh, box_sh, lab_sh, lab_sh),
+                   out_shardings=(repl, repl), donate_argnums=(0,))
+
+
 def save_checkpoint(save_path: str, epoch: int, state: TrainState,
                     loss_log: LossLog) -> str:
     """Per-epoch full-state checkpoint (≡ ref train.py:76-82
@@ -237,10 +279,53 @@ def make_snapshot_fn(model, cfg: Config):
     return snapshot
 
 
-def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, train_step,
+def make_step_runner(cfg: Config, mesh, model, tx):
+    """Build `runner(state, batch, step_idx) -> (state, losses)` for the
+    configured input path.
+
+    Host path: targets encoded in collate; runner shards the 5 arrays and
+    calls the plain train step. Device path (`--device-augment`): runner
+    shards raw canvases + padded boxes and calls the fused
+    augment+encode+train step, one jit cache entry per multiscale bucket.
+    """
+    if not cfg.device_augment:
+        step = make_train_step(model, tx, cfg, mesh)
+
+        def runner(state, batch, step_idx):
+            arrays = shard_batch(
+                mesh, (batch.image, batch.heatmap, batch.offset, batch.wh,
+                       batch.mask), spatial_dims=[1] * 5)
+            return step(state, *arrays)
+
+        return runner
+
+    sizes = (list(range(cfg.multiscale[0], cfg.multiscale[1],
+                        cfg.multiscale[2]))
+             if cfg.multiscale_flag else [cfg.multiscale[1]])
+    base_key = jax.random.key(cfg.random_seed + 2)
+    steps = {}  # target -> fused jitted step (bucketed multiscale)
+
+    def runner(state, batch, step_idx):
+        # keyed on (seed, global step): resume-deterministic, unlike a
+        # stateful generator that restarts its stream on every process
+        target = int(np.random.default_rng(
+            (cfg.random_seed, step_idx)).choice(sizes))
+        if target not in steps:
+            steps[target] = make_device_train_step(model, tx, cfg, mesh,
+                                                   target)
+        key = jax.random.fold_in(base_key, step_idx)
+        images, boxes, labels, valid = shard_batch(
+            mesh, (batch.image, batch.boxes, batch.labels, batch.valid))
+        return steps[target](state, key, images, boxes, labels, valid)
+
+    return runner
+
+
+def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
                 state: TrainState, mesh, loss_log: LossLog,
                 is_chief: bool = True, snapshot_fn=None,
-                profile_this_epoch: bool = False) -> TrainState:
+                profile_this_epoch: bool = False,
+                epoch_base_step: int = 0) -> TrainState:
     """One epoch of the hot loop (≡ ref train.py:86-162 `train_step`)."""
     meters = {k: AverageMeter() for k in ("data", "step")}
     loader.set_epoch(epoch)
@@ -255,12 +340,7 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, train_step,
             jax.profiler.start_trace(os.path.join(cfg.save_path, "trace"))
             profiling = True
 
-        # host->device: local shard -> global sharded arrays (multi-host
-        # assembles the global batch; ≡ ref .to(device), train.py:99)
-        arrays = shard_batch(mesh, (batch.image, batch.heatmap, batch.offset,
-                                    batch.wh, batch.mask),
-                             spatial_dims=[1] * 5)
-        state, losses = train_step(state, *arrays)
+        state, losses = step_runner(state, batch, epoch_base_step + i)
         losses = jax.device_get(losses)
         loss_log.append(losses)
         meters["step"].update(time.time() - tic - data_t)
@@ -277,7 +357,9 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, train_step,
                      loss_log.get_log(length=cfg.print_interval),
                      meters["data"].avg, meters["step"].avg), flush=True)
             snapshot_dir = os.path.join(cfg.save_path, "training_log")
-            if os.path.isdir(snapshot_dir):
+            # host-augment path only: raw batches carry no GT maps and
+            # un-normalized images
+            if os.path.isdir(snapshot_dir) and not cfg.device_augment:
                 blend_heatmap(batch.image, batch.heatmap, cfg.pretrained).save(
                     os.path.join(snapshot_dir, f"e{epoch}_i{i}_gt.png"))
                 # single-host only: with multiple processes the snapshot
@@ -285,7 +367,8 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, train_step,
                 # raise) and the global batch != the local batch.image
                 if snapshot_fn is not None and jax.process_count() == 1:
                     pred = jax.device_get(snapshot_fn(
-                        state.params, state.batch_stats, arrays[0]))
+                        state.params, state.batch_stats,
+                        jnp.asarray(batch.image)))
                     blend_heatmap(batch.image, pred, cfg.pretrained).save(
                         os.path.join(snapshot_dir, f"e{epoch}_i{i}_pred.png"))
         tic = time.time()
@@ -308,13 +391,19 @@ def train(cfg: Config) -> TrainState:
     is_chief = jax.process_index() == 0
 
     dataset, augmentor = load_dataset(cfg)
+    if cfg.device_augment:
+        # host does decode + deterministic canvas resize only; random
+        # augmentation + GT encode run on-device inside the fused step
+        from .data import TestAugmentor
+        augmentor = TestAugmentor(imsize=cfg.multiscale[1])
     loader = BatchLoader(
         dataset, augmentor, batch_size=cfg.batch_size // jax.process_count(),
         pretrained=cfg.pretrained, num_cls=cfg.num_cls,
         normalized_coord=cfg.normalized_coord, scale_factor=cfg.scale_factor,
         max_boxes=cfg.max_boxes, shuffle=True, drop_last=True,
         rank=jax.process_index(), world_size=jax.process_count(),
-        seed=cfg.random_seed, num_workers=cfg.num_workers)
+        seed=cfg.random_seed, num_workers=cfg.num_workers,
+        raw=cfg.device_augment)
     steps_per_epoch = max(1, len(loader))
 
     dtype = jnp.bfloat16 if cfg.amp else None
@@ -332,18 +421,20 @@ def train(cfg: Config) -> TrainState:
             print("%s: resumed from %s (epoch %d)"
                   % (timestamp(), cfg.model_load, ckpt_epoch), flush=True)
 
-    step_fn = make_train_step(model, tx, cfg, mesh)
-    snapshot_fn = make_snapshot_fn(model, cfg) if is_chief else None
+    runner = make_step_runner(cfg, mesh, model, tx)
+    snapshot_fn = (make_snapshot_fn(model, cfg)
+                   if is_chief and not cfg.device_augment else None)
     if is_chief:
         nparams = sum(x.size for x in jax.tree.leaves(state.params))
         print("%s: model built, %d params, mesh %s" % (
             timestamp(), nparams, dict(mesh.shape)), flush=True)
 
     for epoch in range(start_epoch, cfg.end_epoch):
-        state = train_epoch(cfg, epoch, loader, step_fn, state, mesh,
+        state = train_epoch(cfg, epoch, loader, runner, state, mesh,
                             loss_log, is_chief, snapshot_fn,
                             profile_this_epoch=(cfg.profile
-                                                and epoch == start_epoch))
+                                                and epoch == start_epoch),
+                            epoch_base_step=epoch * steps_per_epoch)
         if is_chief:
             path = save_checkpoint(cfg.save_path, epoch, state, loss_log)
             print("%s: epoch %d checkpoint -> %s" % (timestamp(), epoch, path),

@@ -22,3 +22,10 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compile cache: the suite is compile-dominated (recursive
+# hourglass at several configs/shapes); warm runs drop from ~10min to ~2min.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__), "..", "build",
+                               "jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,104 @@
+"""On-device augmentation tests: analytic cases (identity, flip, resize),
+box envelope math vs the host twin, filtering semantics, full
+augment+encode pipeline shapes and determinism.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_helmet_detection_tpu.data import augment as host_aug
+from real_time_helmet_detection_tpu.data.augment_device import (
+    augment_encode_batch, build_matrix, filter_boxes_jax, sample_params,
+    transform_boxes_jax, warp_image)
+
+
+def identity_params(b=1, flip=False):
+    return {
+        "scale": jnp.ones((b,)),
+        "translate": jnp.zeros((b, 2)),
+        "crop": jnp.zeros((b, 4)),
+        "flip": jnp.full((b,), flip),
+        "color": jnp.ones((b,)),
+    }
+
+
+def test_identity_matrix_preserves_image():
+    rng = np.random.default_rng(0)
+    img = jnp.asarray(rng.uniform(0, 255, (16, 16, 3)).astype(np.float32))
+    p = {k: v[0] for k, v in identity_params().items()}
+    m = build_matrix(p, 16.0, 16.0, 16.0)
+    out = warp_image(img, m, 16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(img), atol=1e-3)
+
+
+def test_flip_matrix_mirrors_image_and_boxes():
+    rng = np.random.default_rng(1)
+    img = jnp.asarray(rng.uniform(0, 255, (8, 8, 3)).astype(np.float32))
+    p = {k: v[0] for k, v in identity_params(flip=True).items()}
+    m = build_matrix(p, 8.0, 8.0, 8.0)
+    out = warp_image(img, m, 8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(img[:, ::-1, :]),
+                               atol=1e-3)
+    boxes = jnp.asarray([[1.0, 2.0, 3.0, 5.0]])
+    got = transform_boxes_jax(boxes, m)
+    np.testing.assert_allclose(np.asarray(got), [[5.0, 2.0, 7.0, 5.0]],
+                               atol=1e-5)
+
+
+def test_resize_matches_host_box_transform():
+    """Box envelope math must match the host augmentor's matrix twin for a
+    random affine."""
+    rng = np.random.default_rng(2)
+    m_np = (host_aug._scaling(1.7, 0.6)
+            @ host_aug._translation(3.0, -2.0))
+    boxes = rng.uniform(0, 50, (5, 4)).astype(np.float32)
+    boxes[:, 2:] += boxes[:, :2]  # make x2>x1, y2>y1
+    want = host_aug.transform_boxes(boxes, m_np)
+    got = transform_boxes_jax(jnp.asarray(boxes), jnp.asarray(m_np,
+                                                              jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-4)
+
+
+def test_filter_boxes_jax_masks_outside():
+    boxes = jnp.asarray([[-10.0, -10.0, -1.0, -1.0],   # fully outside
+                         [-5.0, 2.0, 10.0, 8.0],       # partial -> clipped
+                         [2.0, 2.0, 6.0, 6.0]])        # inside
+    valid = jnp.asarray([True, True, True])
+    clipped, keep = filter_boxes_jax(boxes, valid, 16.0)
+    assert keep.tolist() == [False, True, True]
+    np.testing.assert_allclose(np.asarray(clipped[1]), [0.0, 2.0, 10.0, 8.0])
+
+
+def test_sample_params_deterministic():
+    a = sample_params(jax.random.key(7), 4)
+    b = sample_params(jax.random.key(7), 4)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+
+def test_augment_encode_batch_end_to_end():
+    rng = np.random.default_rng(3)
+    b, h, w, n = 2, 48, 64, 8
+    images = jnp.asarray(rng.uniform(0, 255, (b, h, w, 3)).astype(np.float32))
+    boxes = np.zeros((b, n, 4), np.float32)
+    labels = np.zeros((b, n), np.int32)
+    valid = np.zeros((b, n), bool)
+    boxes[0, 0] = [10, 10, 30, 30]
+    labels[0, 0] = 1
+    valid[0, 0] = True
+    out = augment_encode_batch(
+        jax.random.key(0), images, jnp.asarray(boxes), jnp.asarray(labels),
+        jnp.asarray(valid), target=32, num_cls=2)
+    img, heat, off, size, mask, bx, vd = (np.asarray(x) for x in out)
+    assert img.shape == (b, 32, 32, 3)
+    assert heat.shape == (b, 8, 8, 2)
+    assert off.shape == (b, 8, 8, 2) and size.shape == (b, 8, 8, 2)
+    assert mask.shape == (b, 8, 8, 1)
+    assert img.min() >= 0.0 and img.max() <= 255.0
+    # image 1 had no boxes: empty targets
+    assert heat[1].max() == 0.0 and mask[1].sum() == 0.0
+    # if image 0's box survived the random warp, its targets are non-empty
+    if vd[0, 0]:
+        assert heat[0].max() > 0.0 and mask[0].sum() == 1.0

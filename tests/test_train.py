@@ -212,6 +212,40 @@ def test_bool_flags_negatable():
     assert parse_args(["--train-flag"]).train_flag is True
 
 
+def test_device_augment_runner_trains():
+    """Fused on-device augment+encode+train path: losses finite and params
+    update, with the raw-canvas batch format."""
+    from real_time_helmet_detection_tpu.data.pipeline import Batch
+    from real_time_helmet_detection_tpu.train import make_step_runner
+
+    cfg = tiny_cfg(device_augment=True, multiscale=[64, 64, 64],
+                   multiscale_flag=False, batch_size=2)
+    model, tx, state = make_state(cfg)
+    mesh = make_mesh(2)
+    runner = make_step_runner(cfg, mesh, model, tx)
+
+    rng = np.random.default_rng(0)
+    n = 8
+    boxes = np.zeros((2, n, 4), np.float32)
+    labels = np.zeros((2, n), np.int32)
+    valid = np.zeros((2, n), bool)
+    boxes[:, 0] = [8, 8, 40, 40]
+    valid[:, 0] = True
+    empty = np.zeros((2, 0, 0, 0), np.float32)
+    batch = Batch(image=rng.uniform(0, 255, (2, 64, 64, 3)
+                                    ).astype(np.float32),
+                  heatmap=empty, offset=empty, wh=empty, mask=empty,
+                  boxes=boxes, labels=labels, valid=valid, infos=[{}, {}])
+
+    p0 = jax.device_get(jax.tree.leaves(state.params)[0]).copy()
+    state, losses = runner(state, batch, 0)
+    assert np.isfinite(float(losses["total"]))
+    state, losses2 = runner(state, batch, 1)
+    assert np.isfinite(float(losses2["total"]))
+    p1 = jax.device_get(jax.tree.leaves(state.params)[0])
+    assert not np.allclose(p0, p1)
+
+
 def test_bf16_policy_step_runs():
     """--amp selects bf16 compute; step must run and return finite fp32 loss."""
     cfg = tiny_cfg(amp=True)
