@@ -32,19 +32,22 @@ class Detections(NamedTuple):
     valid: jax.Array   # (topk,) bool — score >= conf_th
 
 
-def peak_mask(heatmap: jax.Array) -> jax.Array:
-    """3x3 max-pool equality peak test (ref transform.py:76-79).
+def peak_mask(heatmap: jax.Array, pool_size: int = 3) -> jax.Array:
+    """pool_size x pool_size max-pool equality peak test
+    (ref transform.py:76-79; the reference parses `--pool-size` but
+    hard-codes 3 — here the flag actually works, SURVEY.md §5 dead flags).
 
     heatmap: (..., H, W, C) channels-last, any number of leading batch dims.
-    Returns bool mask of local maxima (ties with the 3x3 neighborhood max
-    count as peaks, matching `==`).
+    Returns bool mask of local maxima (ties with the neighborhood max count
+    as peaks, matching `==`).
     """
     lead = heatmap.ndim - 3
+    p = (pool_size - 1) // 2
     pooled = jax.lax.reduce_window(
         heatmap, -jnp.inf, jax.lax.max,
-        window_dimensions=(1,) * lead + (3, 3, 1),
+        window_dimensions=(1,) * lead + (pool_size, pool_size, 1),
         window_strides=(1,) * (lead + 3),
-        padding=((0, 0),) * lead + ((1, 1), (1, 1), (0, 0)))
+        padding=((0, 0),) * lead + ((p, p), (p, p), (0, 0)))
     return pooled == heatmap
 
 
@@ -97,10 +100,12 @@ def decode_peak_scores(peaks: jax.Array, offset: jax.Array, wh: jax.Array,
                       scores=scores, valid=valid)
 
 
-@partial(jax.jit, static_argnames=("scale_factor", "topk", "normalized"))
+@partial(jax.jit, static_argnames=("scale_factor", "topk", "normalized",
+                                   "pool_size"))
 def decode_heatmap(heatmap: jax.Array, offset: jax.Array, wh: jax.Array,
                    scale_factor: int = 4, topk: int = 100,
-                   conf_th: float = 0.3, normalized: bool = False) -> Detections:
+                   conf_th: float = 0.3, normalized: bool = False,
+                   pool_size: int = 3) -> Detections:
     """Decode one image's maps into top-k boxes.
 
     Args:
@@ -112,10 +117,11 @@ def decode_heatmap(heatmap: jax.Array, offset: jax.Array, wh: jax.Array,
       conf_th: confidence threshold, applied as the `valid` mask.
       normalized: if True, un-normalize offsets (*scale_factor) and sizes
         (*map width/height) as in the reference.
+      pool_size: peak-test window (static).
 
     Returns a `Detections` with static shapes.
     """
-    peaks = jnp.where(peak_mask(heatmap), heatmap, 0.0)
+    peaks = jnp.where(peak_mask(heatmap, pool_size), heatmap, 0.0)
     return decode_peak_scores(peaks, offset, wh, scale_factor=scale_factor,
                               topk=topk, conf_th=conf_th,
                               normalized=normalized)
