@@ -1,67 +1,255 @@
-"""Benchmark: end-to-end inference throughput at 512x512 on one chip.
+"""Benchmark: single-chip perf evidence for the TPU framework.
 
 Headline reference number: 100 FPS at 512x512 on a GTX 1080 Ti via the
-TorchScript C++ app (/root/reference/README.md:76). This benchmark times the
-same fused path — network forward -> sigmoid -> decode -> NMS — as ONE jitted
-XLA program, steady-state, device-synchronized, and reports images/sec.
+TorchScript C++ app (/root/reference/README.md:76). This bench measures, on
+one chip, steady-state and device-synchronized:
 
-Prints one JSON line:
-  {"metric": "inference_fps_512", "value": N, "unit": "img/s", "vs_baseline": N/100}
+* `inference_fps_512` (primary) — the fused predict path (network forward
+  -> sigmoid -> decode -> NMS) as ONE jitted XLA program at batch 8;
+* `latency_ms_b1` — median batch-1 latency (the reference's "real-time"
+  framing);
+* `train_img_per_sec_chip` — train-step throughput at the flagship config
+  (batch 16, 512^2, bf16) — BASELINE.json's north-star metric;
+* `mfu_fwd` / `mfu_train` — analytic MFU from XLA's compiled cost
+  analysis vs the chip's peak bf16 FLOP/s;
+* `peak_pallas_ms` / `peak_xla_ms` — the fused Pallas sigmoid+3x3-peak
+  kernel vs the XLA reduce_window path it replaces, plus an on-device
+  bit-identity check.
+
+Robustness (round-1 postmortem: BENCH_r01.json was rc=1 because the remote
+TPU backend failed to initialize and the bench had no handling): backend
+acquisition retries with backoff and diagnostics; if the TPU never comes up
+the bench re-execs itself onto the CPU backend so a clearly-labeled
+(platform="cpu", scaled-down shapes) JSON line is still produced. Every
+section is independently guarded — a partial failure nulls that field
+instead of killing the run.
+
+Prints ONE JSON line; the primary metric fields come first.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 BASELINE_FPS = 100.0  # reference README.md:76
-BATCH = 8
-IMSIZE = 512
-WARMUP = 3
-ITERS = 20
+
+# Peak bf16 FLOP/s per chip (jax-ml scaling-book numbers); used for MFU.
+PEAK_BF16 = {
+    "v4": 2.75e14,
+    "v5e": 1.97e14,
+    "v5 lite": 1.97e14,
+    "v5p": 4.59e14,
+    "v6e": 9.18e14,
+    "v6 lite": 9.18e14,
+    "trillium": 9.18e14,
+}
+DEFAULT_PEAK = 1.97e14  # v5e — the BASELINE.json target chip
+
+
+def log(msg: str) -> None:
+    print("[bench] %s" % msg, file=sys.stderr, flush=True)
+
+
+def acquire_backend(retries: int = 3, backoff_s: float = 15.0):
+    """Initialize the JAX backend with retry/backoff; returns (jax, devices)
+    or re-execs onto CPU as a last resort."""
+    import jax
+    if "--cpu" in sys.argv:
+        jax.config.update("jax_platforms", "cpu")
+    last = None
+    for attempt in range(retries):
+        try:
+            devs = jax.devices()
+            # force a real device op: backend init can defer failures
+            import jax.numpy as jnp
+            jax.block_until_ready(jnp.zeros((8, 8)) + 1.0)
+            return jax, devs
+        except Exception as e:  # noqa: BLE001 — init errors vary by plugin
+            last = e
+            log("backend init attempt %d/%d failed: %s"
+                % (attempt + 1, retries, str(e).splitlines()[-1] if str(e)
+                   else repr(e)))
+            time.sleep(backoff_s * (attempt + 1))
+    if "--cpu" not in sys.argv:
+        log("TPU backend unavailable after %d attempts; re-exec on CPU "
+            "(numbers will be labeled platform=cpu)" % retries)
+        os.execv(sys.executable, [sys.executable, os.path.abspath(__file__),
+                                  "--cpu"] + sys.argv[1:])
+    raise SystemExit("no backend available: %r" % last)
+
+
+def timed(fn, iters: int):
+    """Median and total wall time of `fn()` (already warmed up)."""
+    import jax
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)), float(np.sum(times))
+
+
+def flops_of(compiled) -> float | None:
+    """Total FLOPs from XLA cost analysis (shape differs across versions)."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost["flops"])
+    except Exception as e:  # noqa: BLE001
+        log("cost_analysis unavailable: %r" % e)
+        return None
 
 
 def main() -> None:
+    jax, devs = acquire_backend()
+    import jax.numpy as jnp
+
+    platform = devs[0].platform
+    device_kind = getattr(devs[0], "device_kind", "unknown")
+    on_tpu = platform == "tpu"
+    log("backend up: %d x %s (%s)" % (len(devs), device_kind, platform))
+
+    peak = DEFAULT_PEAK
+    peak_known = False
+    for key, val in PEAK_BF16.items():
+        if key in device_kind.lower():
+            peak, peak_known = val, True
+            break
+
+    # CPU fallback: scaled-down shapes so the bench finishes; clearly labeled.
+    imsize = 512 if on_tpu else 128
+    batch = 8 if on_tpu else 2
+    train_batch = 16 if on_tpu else 2
+    iters = 20 if on_tpu else 5
+
     from real_time_helmet_detection_tpu.config import Config
     from real_time_helmet_detection_tpu.models import build_model
     from real_time_helmet_detection_tpu.predict import make_predict_fn
-
-    cfg = Config(num_stack=1, hourglass_inch=128, num_cls=2, topk=100,
-                 conf_th=0.0, nms_th=0.5, imsize=IMSIZE)
     from real_time_helmet_detection_tpu.train import init_variables
 
-    # bf16 compute is the deployment fast path on TPU (params fp32, decode
-    # fp32); BENCH_DTYPE=fp32 benches the reference-comparable fp32 path.
-    import os
     dtype = None if os.environ.get("BENCH_DTYPE") == "fp32" else jnp.bfloat16
+    cfg = Config(num_stack=1, hourglass_inch=128, num_cls=2, topk=100,
+                 conf_th=0.0, nms_th=0.5, imsize=imsize)
     model = build_model(cfg, dtype=dtype)
-    rng = jax.random.key(0)
-    images = jnp.asarray(
-        np.random.default_rng(0).standard_normal(
-            (BATCH, IMSIZE, IMSIZE, 3)).astype(np.float32))
-    # jitted init: eager init over the remote-TPU tunnel is minutes-slow
-    params, batch_stats = init_variables(model, rng, IMSIZE)
+    rng = np.random.default_rng(0)
+    out = {
+        "metric": "inference_fps_%d" % imsize, "value": None, "unit": "img/s",
+        "vs_baseline": None, "platform": platform,
+        "device_kind": device_kind,
+        "dtype": "float32" if dtype is None else "bfloat16",
+        "imsize": imsize, "batch": batch,
+    }
+
+    params, batch_stats = init_variables(model, jax.random.key(0), imsize)
     variables = {"params": params, "batch_stats": batch_stats}
     predict = make_predict_fn(model, cfg)
 
-    for _ in range(WARMUP):
-        jax.block_until_ready(predict(variables, images))
+    # --- inference throughput (primary) + MFU(fwd) ------------------------
+    try:
+        images = jnp.asarray(rng.standard_normal(
+            (batch, imsize, imsize, 3)).astype(np.float32))
+        # predict is already jitted; lower/compile it ONCE and run the
+        # compiled executable directly (no second compile via the call cache)
+        compiled = predict.lower(variables, images).compile()
+        fwd_flops = flops_of(compiled)
+        for _ in range(3):
+            jax.block_until_ready(compiled(variables, images))
+        _, total = timed(lambda: compiled(variables, images), iters)
+        fps = batch * iters / total
+        out["value"] = round(fps, 2)
+        # vs_baseline only against the reference's own 512^2 setting
+        if imsize == 512:
+            out["vs_baseline"] = round(fps / BASELINE_FPS, 3)
+        if fwd_flops:
+            out["mfu_fwd"] = round(fwd_flops * iters / total / peak, 4)
+        log("inference: %.1f img/s" % fps)
+    except Exception as e:  # noqa: BLE001
+        log("inference bench failed: %r" % e)
 
-    tic = time.perf_counter()
-    for _ in range(ITERS):
-        jax.block_until_ready(predict(variables, images))
-    dt = time.perf_counter() - tic
+    # --- batch-1 latency ---------------------------------------------------
+    try:
+        img1 = jnp.asarray(rng.standard_normal(
+            (1, imsize, imsize, 3)).astype(np.float32))
+        for _ in range(3):
+            jax.block_until_ready(predict(variables, img1))
+        med, _ = timed(lambda: predict(variables, img1), iters)
+        out["latency_ms_b1"] = round(med * 1e3, 3)
+        log("batch-1 latency: %.2f ms" % (med * 1e3))
+    except Exception as e:  # noqa: BLE001
+        log("latency bench failed: %r" % e)
 
-    fps = BATCH * ITERS / dt
-    print(json.dumps({"metric": "inference_fps_512",
-                      "value": round(fps, 2), "unit": "img/s",
-                      "dtype": "float32" if dtype is None else "bfloat16",
-                      "batch": BATCH,
-                      "vs_baseline": round(fps / BASELINE_FPS, 3)}))
+    # --- train-step throughput + MFU(train) -------------------------------
+    try:
+        from real_time_helmet_detection_tpu.optim import build_optimizer
+        from real_time_helmet_detection_tpu.parallel import (make_mesh,
+                                                             shard_batch)
+        from real_time_helmet_detection_tpu.train import (create_train_state,
+                                                          make_train_step)
+        tcfg = Config(num_stack=1, hourglass_inch=128, num_cls=2,
+                      batch_size=train_batch, amp=dtype is not None,
+                      imsize=imsize)
+        tmodel = build_model(tcfg, dtype=dtype)
+        tx = build_optimizer(tcfg, 100)
+        state = create_train_state(tmodel, tcfg, jax.random.key(0), imsize, tx)
+        mesh = make_mesh(1)
+        step = make_train_step(tmodel, tx, tcfg, mesh)
+        from real_time_helmet_detection_tpu.data import synthetic_target_batch
+        arrs = shard_batch(mesh, synthetic_target_batch(train_batch, imsize,
+                                                        pos_rate=0.01),
+                           spatial_dims=[1] * 5)
+        # make_train_step returns a jitted fn (donation included): compile
+        # once, reuse the executable for both cost analysis and timing
+        tcompiled = step.lower(state, *arrs).compile()
+        train_flops = flops_of(tcompiled)
+        for _ in range(2):
+            state, _ = tcompiled(state, *arrs)
+        jax.block_until_ready(state.params)
+        titers = max(5, iters // 2)
+        t0 = time.perf_counter()
+        for _ in range(titers):
+            state, losses = tcompiled(state, *arrs)
+        jax.block_until_ready(losses["total"])
+        dt = time.perf_counter() - t0
+        out["train_img_per_sec_chip"] = round(train_batch * titers / dt, 2)
+        out["train_batch"] = train_batch
+        if train_flops:
+            out["mfu_train"] = round(train_flops * titers / dt / peak, 4)
+        out["mfu_peak_flops"] = peak
+        out["mfu_peak_known"] = peak_known
+        log("train: %.1f img/s/chip" % (train_batch * titers / dt))
+    except Exception as e:  # noqa: BLE001
+        log("train bench failed: %r" % e)
+
+    # --- Pallas fused peak kernel vs XLA path (TPU only) ------------------
+    if on_tpu:
+        try:
+            from real_time_helmet_detection_tpu.ops.pallas.peak import (
+                fused_peak_scores, peak_scores_reference)
+            logits = jnp.asarray(rng.standard_normal(
+                (batch, imsize // 4, imsize // 4, 2)).astype(np.float32) * 4)
+            pall = jax.jit(jax.vmap(
+                lambda x: fused_peak_scores(x, interpret=False)))
+            xla = jax.jit(jax.vmap(peak_scores_reference))
+            a = jax.block_until_ready(pall(logits))
+            b = jax.block_until_ready(xla(logits))
+            out["pallas_matches_xla"] = bool(
+                jnp.array_equal(a, b).item())
+            mp, _ = timed(lambda: pall(logits), 50)
+            mx, _ = timed(lambda: xla(logits), 50)
+            out["peak_pallas_ms"] = round(mp * 1e3, 4)
+            out["peak_xla_ms"] = round(mx * 1e3, 4)
+            log("pallas peak: %.3f ms vs xla %.3f ms (match=%s)"
+                % (mp * 1e3, mx * 1e3, out["pallas_matches_xla"]))
+        except Exception as e:  # noqa: BLE001
+            log("pallas bench failed: %r" % e)
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,195 @@
+// Stub PJRT plugin — TEST FIXTURE for pjrt_runner (tests/test_pjrt_runner.py).
+//
+// No real CPU PJRT plugin .so ships in this image (jaxlib's CPU client is
+// linked into the Python extension, not exported as a C-API plugin), so CI
+// exercises the runner's full PJRT control flow — plugin load, client
+// create, compile, H2D, execute, D2H, detection printing — against this
+// in-memory implementation of exactly the C-API surface the runner uses.
+// "Compile" accepts any program; "execute" returns canned detections the
+// test asserts on. Real-hardware runs use the TPU plugin (see the
+// TPU-gated test); this stub only validates the runner binary's ABI usage
+// and control flow, the same role as a fake backend in the Python suite.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+struct PJRT_Error {
+  std::string message;
+};
+struct PJRT_Event {};
+struct PJRT_Device {};
+struct PJRT_Client {
+  PJRT_Device device;
+  PJRT_Device* devices[1];
+};
+struct PJRT_Executable {
+  size_t num_outputs = 4;
+};
+struct PJRT_LoadedExecutable {
+  PJRT_Executable executable;
+  int64_t batch = 1;
+};
+struct PJRT_Buffer {
+  std::vector<int64_t> dims;
+  std::vector<char> data;
+};
+
+namespace {
+
+constexpr int64_t kNumBoxes = 8;
+
+void ErrorMessage(PJRT_Error_Message_Args* args) {
+  args->message = args->error->message.c_str();
+  args->message_size = args->error->message.size();
+}
+
+void ErrorDestroy(PJRT_Error_Destroy_Args* args) { delete args->error; }
+
+PJRT_Error* EventAwait(PJRT_Event_Await_Args* args) { return nullptr; }
+
+PJRT_Error* EventDestroy(PJRT_Event_Destroy_Args* args) {
+  delete args->event;
+  return nullptr;
+}
+
+PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
+  auto* client = new PJRT_Client;
+  client->devices[0] = &client->device;
+  args->client = client;
+  return nullptr;
+}
+
+PJRT_Error* AddressableDevices(PJRT_Client_AddressableDevices_Args* args) {
+  args->addressable_devices = args->client->devices;
+  args->num_addressable_devices = 1;
+  return nullptr;
+}
+
+PJRT_Error* Compile(PJRT_Client_Compile_Args* args) {
+  if (args->program == nullptr || args->program->code_size == 0)
+    return new PJRT_Error{"empty program"};
+  args->executable = new PJRT_LoadedExecutable;
+  return nullptr;
+}
+
+PJRT_Error* BufferFromHost(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  auto* buf = new PJRT_Buffer;
+  buf->dims.assign(args->dims, args->dims + args->num_dims);
+  size_t elems = 1;
+  for (size_t i = 0; i < args->num_dims; ++i) elems *= args->dims[i];
+  buf->data.resize(elems * sizeof(float));
+  if (args->data) std::memcpy(buf->data.data(), args->data, buf->data.size());
+  args->buffer = buf;
+  args->done_with_host_buffer = new PJRT_Event;
+  return nullptr;
+}
+
+PJRT_Error* GetExecutable(PJRT_LoadedExecutable_GetExecutable_Args* args) {
+  args->executable = &args->loaded_executable->executable;
+  return nullptr;
+}
+
+PJRT_Error* NumOutputs(PJRT_Executable_NumOutputs_Args* args) {
+  args->num_outputs = args->executable->num_outputs;
+  return nullptr;
+}
+
+PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
+  if (args->num_devices != 1 || args->num_args != 1)
+    return new PJRT_Error{"stub expects 1 device, 1 arg"};
+  const int64_t b = args->executable->batch;
+
+  auto* boxes = new PJRT_Buffer;
+  boxes->dims = {b, kNumBoxes, 4};
+  std::vector<float> bx(b * kNumBoxes * 4, 0.0f);
+  float det0[4] = {10.0f, 20.0f, 30.0f, 40.0f};
+  float det1[4] = {50.0f, 60.0f, 70.0f, 80.0f};
+  std::memcpy(&bx[0], det0, sizeof(det0));
+  std::memcpy(&bx[4], det1, sizeof(det1));
+  boxes->data.assign(reinterpret_cast<char*>(bx.data()),
+                     reinterpret_cast<char*>(bx.data() + bx.size()));
+
+  auto* classes = new PJRT_Buffer;
+  classes->dims = {b, kNumBoxes};
+  std::vector<int32_t> cl(b * kNumBoxes, 0);
+  cl[1] = 1;
+  classes->data.assign(reinterpret_cast<char*>(cl.data()),
+                       reinterpret_cast<char*>(cl.data() + cl.size()));
+
+  auto* scores = new PJRT_Buffer;
+  scores->dims = {b, kNumBoxes};
+  std::vector<float> sc(b * kNumBoxes, 0.0f);
+  sc[0] = 0.9f;
+  sc[1] = 0.8f;
+  scores->data.assign(reinterpret_cast<char*>(sc.data()),
+                      reinterpret_cast<char*>(sc.data() + sc.size()));
+
+  auto* valid = new PJRT_Buffer;
+  valid->dims = {b, kNumBoxes};
+  valid->data.assign(b * kNumBoxes, 0);
+  valid->data[0] = 1;
+  valid->data[1] = 1;
+
+  args->output_lists[0][0] = boxes;
+  args->output_lists[0][1] = classes;
+  args->output_lists[0][2] = scores;
+  args->output_lists[0][3] = valid;
+  if (args->device_complete_events)
+    args->device_complete_events[0] = new PJRT_Event;
+  return nullptr;
+}
+
+PJRT_Error* BufferDimensions(PJRT_Buffer_Dimensions_Args* args) {
+  args->dims = args->buffer->dims.data();
+  args->num_dims = args->buffer->dims.size();
+  return nullptr;
+}
+
+PJRT_Error* ToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
+  if (args->dst == nullptr) {
+    args->dst_size = args->src->data.size();
+    return nullptr;
+  }
+  std::memcpy(args->dst, args->src->data.data(), args->src->data.size());
+  args->event = new PJRT_Event;
+  return nullptr;
+}
+
+PJRT_Error* BufferDestroy(PJRT_Buffer_Destroy_Args* args) {
+  delete args->buffer;
+  return nullptr;
+}
+
+PJRT_Api MakeApi() {
+  PJRT_Api api;
+  std::memset(&api, 0, sizeof(api));
+  api.struct_size = PJRT_Api_STRUCT_SIZE;
+  api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  api.PJRT_Error_Message = ErrorMessage;
+  api.PJRT_Error_Destroy = ErrorDestroy;
+  api.PJRT_Event_Await = EventAwait;
+  api.PJRT_Event_Destroy = EventDestroy;
+  api.PJRT_Client_Create = ClientCreate;
+  api.PJRT_Client_AddressableDevices = AddressableDevices;
+  api.PJRT_Client_Compile = Compile;
+  api.PJRT_Client_BufferFromHostBuffer = BufferFromHost;
+  api.PJRT_LoadedExecutable_GetExecutable = GetExecutable;
+  api.PJRT_Executable_NumOutputs = NumOutputs;
+  api.PJRT_LoadedExecutable_Execute = Execute;
+  api.PJRT_Buffer_Dimensions = BufferDimensions;
+  api.PJRT_Buffer_ToHostBuffer = ToHostBuffer;
+  api.PJRT_Buffer_Destroy = BufferDestroy;
+  return api;
+}
+
+PJRT_Api g_stub_api = MakeApi();
+
+}  // namespace
+
+extern "C" __attribute__((visibility("default"))) const PJRT_Api*
+GetPjrtApi() { return &g_stub_api; }

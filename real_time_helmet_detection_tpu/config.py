@@ -51,6 +51,7 @@ class Config:
 
     # device
     num_devices: int = 0          # 0 = use every visible device
+    spatial: int = 1              # spatial mesh-axis size (shards H of the maps)
     platform: str = ""            # force a jax platform ("cpu"/"tpu"); "" = default
     random_seed: int = 777
 

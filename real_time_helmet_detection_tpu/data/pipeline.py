@@ -47,8 +47,19 @@ class Batch:
     infos: List[dict]     # per-image voc dicts (eval needs origin size)
 
 
+_overflow_warned = False
+
+
 def pad_boxes(boxes: np.ndarray, labels: np.ndarray, max_boxes: int):
+    global _overflow_warned
     n = min(len(boxes), max_boxes)
+    if len(boxes) > max_boxes and not _overflow_warned:
+        _overflow_warned = True
+        import warnings
+        warnings.warn(
+            "image with %d boxes exceeds --max-boxes %d; the excess boxes "
+            "lose heatmap/offset supervision (raise --max-boxes)"
+            % (len(boxes), max_boxes), stacklevel=2)
     b = np.zeros((max_boxes, 4), np.float32)
     l = np.zeros((max_boxes,), np.int32)
     v = np.zeros((max_boxes,), bool)

@@ -60,13 +60,25 @@ def make_synthetic_voc(root: str, num_train: int = 8, num_test: int = 4,
                 rng.integers(0, 80, (h, w, 3), dtype=np.uint8))
             draw = ImageDraw.Draw(img)
             objects = []
+            placed = []
             for _ in range(int(rng.integers(1, max_objects + 1))):
                 cls = int(rng.integers(0, 2))
-                bw = int(rng.integers(w // 8, w // 3))
-                bh = int(rng.integers(h // 8, h // 3))
-                x1 = int(rng.integers(0, w - bw))
-                y1 = int(rng.integers(0, h - bh))
-                x2, y2 = x1 + bw, y1 + bh
+                # rejection-sample a NON-overlapping placement: rectangles
+                # are opaque, so an overlapped box would lose its pixel
+                # evidence and be unlearnable — a fixture artifact, not a
+                # property of real data
+                for _attempt in range(20):
+                    bw = int(rng.integers(w // 8, w // 3))
+                    bh = int(rng.integers(h // 8, h // 3))
+                    x1 = int(rng.integers(0, w - bw))
+                    y1 = int(rng.integers(0, h - bh))
+                    x2, y2 = x1 + bw, y1 + bh
+                    if all(x1 >= px2 or x2 <= px1 or y1 >= py2 or y2 <= py1
+                           for px1, py1, px2, py2 in placed):
+                        break
+                else:
+                    continue  # no free spot; place fewer objects
+                placed.append((x1, y1, x2, y2))
                 color = (220, 40, 40) if cls == 0 else (40, 220, 40)
                 draw.rectangle([x1, y1, x2, y2], fill=color)
                 objects.append(_OBJ.format(name=INDEX2CLASS[cls], x1=x1, y1=y1,
@@ -78,3 +90,23 @@ def make_synthetic_voc(root: str, num_train: int = 8, num_test: int = 4,
         with open(os.path.join(set_dir, split + ".txt"), "w") as f:
             f.write("\n".join(names) + "\n")
     return root
+
+
+def synthetic_target_batch(batch: int, imsize: int, num_cls: int = 2,
+                           scale_factor: int = 4, seed: int = 0,
+                           pos_rate: float = 0.05):
+    """Random (image, heatmap, offset, wh, mask) batch with the train-step
+    input contract (channels-last, encoded-map shapes at imsize/scale).
+
+    The single source of truth for the synthetic batches used by the train
+    tests, bench.py, scaling.py and the multichip dryrun — one place to
+    update if the GT encoding contract ever changes.
+    """
+    m = imsize // scale_factor
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((batch, imsize, imsize, 3)).astype(np.float32),
+            rng.uniform(0, 1, (batch, m, m, num_cls)).astype(np.float32),
+            rng.uniform(0, 1, (batch, m, m, 2)).astype(np.float32),
+            rng.uniform(1, 8, (batch, m, m, 2)).astype(np.float32),
+            (rng.uniform(0, 1, (batch, m, m, 1)) < pos_rate
+             ).astype(np.float32))

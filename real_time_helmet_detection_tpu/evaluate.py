@@ -92,7 +92,16 @@ def evaluate(cfg: Config) -> Dict:
     for i, batch in enumerate(loader):
         meters["data"].update(time.time() - tic)
         t0 = time.time()
-        dets = jax.device_get(predict(variables, jnp.asarray(batch.image)))
+        images = batch.image
+        if images.shape[0] < cfg.batch_size:
+            # pad the final partial batch to the steady-state shape: one
+            # jitted program for the whole eval instead of a second XLA
+            # compile on the odd last shape; batch.infos bounds the
+            # consumption loop so padding rows are never read
+            pad = cfg.batch_size - images.shape[0]
+            images = np.concatenate(
+                [images, np.zeros((pad,) + images.shape[1:], images.dtype)])
+        dets = jax.device_get(predict(variables, jnp.asarray(images)))
         meters["predict"].update(time.time() - t0)
 
         for b, info in enumerate(batch.infos):

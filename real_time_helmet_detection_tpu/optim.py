@@ -11,8 +11,12 @@ gamma 0.1), re-designed for step-based optax schedules:
   the reference the flag is parsed but Adam is hard-coded (ref optim.py:4,
   SURVEY.md §5 dead flags);
 * gradient accumulation (`--sub-divisions`, ref train.py:124-139) is
-  `optax.MultiSteps`, which applies the averaged update every k-th step —
-  the same micro-batch semantics without any host-side flag juggling.
+  `optax.MultiSteps` with the inner optimizer fed `k * mean(micro-grads)`
+  — i.e. the *sum* of micro-batch gradients, exactly what the reference's
+  repeated `backward()` with no division accumulates (ref
+  train.py:128-136). `MultiSteps` alone would feed the mean, which for
+  Adam is nearly equivalent (Adam is gradient-scale-invariant up to eps)
+  but for SGD would shrink the effective LR by `k`.
 """
 
 from __future__ import annotations
@@ -47,5 +51,9 @@ def build_optimizer(cfg, steps_per_epoch: int) -> optax.GradientTransformation:
     else:
         raise NotImplementedError("Not expected optimizer: %s" % cfg.optim)
     if cfg.sub_divisions > 1:
-        tx = optax.MultiSteps(tx, every_k_schedule=cfg.sub_divisions)
+        # MultiSteps emits the micro-grad mean; pre-scaling the inner
+        # optimizer's input by k turns that into the reference's summed
+        # gradient (ref train.py:128-136 accumulates without dividing).
+        inner = optax.chain(optax.scale(float(cfg.sub_divisions)), tx)
+        tx = optax.MultiSteps(inner, every_k_schedule=cfg.sub_divisions)
     return tx

@@ -224,26 +224,39 @@ def load_checkpoint(path: str, state: TrainState):
     """Restore (state, epoch, loss_log) from a checkpoint dir for training
     resume (≡ ref train.py:190-199). `state` supplies the pytree structure;
     the optimizer configuration must match the one the checkpoint was
-    trained with."""
-    raw_ckpt = _restore_raw(path)
-    restored = raw_ckpt["state"]
+    trained with.
 
-    def refit(target, raw):
-        # map the raw nested-dict leaves back onto the target pytree types
-        return jax.tree.unflatten(jax.tree.structure(target),
-                                  jax.tree.leaves(raw))
-
+    The restore is *targeted*: orbax gets an abstract pytree built from the
+    live TrainState, so namedtuple optimizer states (e.g.
+    optax.MultiStepsState, whose field order differs from the alphabetical
+    key order a structure-free restore returns) are rebuilt field-by-field
+    rather than by flat leaf order.
+    """
+    import orbax.checkpoint as ocp
+    apath = os.path.abspath(path)
+    if not os.path.isdir(apath):
+        raise FileNotFoundError("checkpoint directory not found: %s" % apath)
+    item = {"state": {"step": state.step, "params": state.params,
+                      "batch_stats": state.batch_stats,
+                      "opt_state": state.opt_state},
+            "epoch": 0}
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
+                            jax.device_get(item))
     try:
-        st = TrainState(
-            step=jnp.asarray(restored["step"]),
-            params=refit(state.params, restored["params"]),
-            batch_stats=refit(state.batch_stats, restored["batch_stats"]),
-            opt_state=refit(state.opt_state, restored["opt_state"]))
-    except ValueError as e:
+        raw_ckpt = ocp.StandardCheckpointer().restore(apath, abstract)
+    except FileNotFoundError:
+        raise
+    except Exception as e:
         raise ValueError(
             "Checkpoint at %s does not match the current model/optimizer "
             "configuration (--optim/--sub-divisions/architecture): %s"
             % (path, e)) from e
+    restored = raw_ckpt["state"]
+    st = TrainState(
+        step=jnp.asarray(restored["step"]),
+        params=restored["params"],
+        batch_stats=restored["batch_stats"],
+        opt_state=restored["opt_state"])
     return st, int(raw_ckpt["epoch"]), _read_loss_log(path)
 
 
@@ -381,13 +394,28 @@ def train(cfg: Config) -> TrainState:
     """Full training driver (≡ ref train.py:23-83
     `distributed_device_train` + `distributed_worker`)."""
     init_distributed(cfg)
-    # The data mesh axis must divide the global batch; use the largest
-    # device count that does (≡ the reference's per-GPU batch split,
-    # ref train.py:38 — but without its silent truncation).
     ndev = cfg.num_devices or len(jax.devices())
-    while cfg.batch_size % ndev:
-        ndev -= 1
-    mesh = make_mesh(ndev)
+    if ndev % cfg.spatial:
+        raise ValueError("--spatial %d must divide the device count %d"
+                         % (cfg.spatial, ndev))
+    # Only the data axis shards the batch; spatial shards H.
+    data = ndev // cfg.spatial
+    if jax.process_count() > 1:
+        # Multi-host: shrinking the mesh would drop whole hosts' devices
+        # while those processes still contribute local shards — fail loudly.
+        if cfg.batch_size % data:
+            raise ValueError(
+                "multi-host run: --batch-size %d must be divisible by the "
+                "data mesh axis %d (devices %d / spatial %d)"
+                % (cfg.batch_size, data, ndev, cfg.spatial))
+    else:
+        # Single-host: use the largest data-axis size that divides the
+        # global batch (≡ the reference's per-GPU batch split,
+        # ref train.py:38 — but without its silent truncation).
+        while cfg.batch_size % data:
+            data -= 1
+        ndev = data * cfg.spatial
+    mesh = make_mesh(ndev, spatial=cfg.spatial)
     is_chief = jax.process_index() == 0
 
     dataset, augmentor = load_dataset(cfg)

@@ -1,0 +1,164 @@
+"""Weak-scaling harness: train-step throughput vs device count.
+
+BASELINE.md demands >= 95% weak-scaling efficiency 1 -> 32 chips at 512^2.
+This harness measures it: for each device count N it runs the sharded train
+step on an N-device ("data") mesh with a FIXED per-chip batch (weak
+scaling), and reports images/sec, images/sec/chip and efficiency vs the
+1-device run. Emits `scaling.json`.
+
+Device counts that exceed the real chip count run on virtual CPU devices
+(`--xla_force_host_platform_device_count`, one fresh subprocess per N since
+the flag is read once at backend init). Virtual-CPU numbers validate the
+*sharding* (compile + execute + collective layout); they are not a hardware
+perf signal — host cores are shared across virtual devices. When a multi-
+chip TPU slice is visible, the same harness measures real ICI scaling.
+
+Usage:
+  python scaling.py                  # 1,2,4,8 on the best available backend
+  python scaling.py --devices 1 2 4  # explicit counts
+  python scaling.py --tpu            # require the TPU backend
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def child(n: int, per_chip_batch: int, imsize: int, iters: int) -> None:
+    """Measure one device count; prints a single JSON line."""
+    import jax
+    if os.environ.get("SCALING_PLATFORM") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.data import synthetic_target_batch
+    from real_time_helmet_detection_tpu.models import build_model
+    from real_time_helmet_detection_tpu.optim import build_optimizer
+    from real_time_helmet_detection_tpu.parallel import make_mesh, shard_batch
+    from real_time_helmet_detection_tpu.train import (create_train_state,
+                                                      make_train_step)
+
+    batch = n * per_chip_batch
+    cfg = Config(num_stack=1,
+                 hourglass_inch=128 if imsize >= 256 else 32,
+                 num_cls=2, batch_size=batch)
+    model = build_model(cfg)
+    tx = build_optimizer(cfg, 100)
+    state = create_train_state(model, cfg, jax.random.key(0), imsize, tx)
+    mesh = make_mesh(n)
+    step = make_train_step(model, tx, cfg, mesh)
+
+    arrs = shard_batch(mesh, synthetic_target_batch(batch, imsize,
+                                                    pos_rate=0.01),
+                       spatial_dims=[1] * 5)
+
+    for _ in range(2):  # compile + settle
+        state, losses = step(state, *arrs)
+    jax.block_until_ready(losses["total"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, losses = step(state, *arrs)
+    jax.block_until_ready(losses["total"])
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "devices": n, "platform": jax.devices()[0].platform,
+        "img_per_sec": round(batch * iters / dt, 2),
+        "img_per_sec_per_chip": round(per_chip_batch * iters / dt, 2),
+        "step_ms": round(dt / iters * 1e3, 2),
+    }))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, nargs="+", default=[1, 2, 4, 8])
+    ap.add_argument("--per-chip-batch", type=int, default=None)
+    ap.add_argument("--imsize", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--tpu", action="store_true",
+                    help="require the TPU backend (no CPU fallback)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="skip the backend probe; use virtual CPU devices")
+    ap.add_argument("--out", default="scaling.json")
+    ap.add_argument("--child", type=int, default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.child is not None:
+        child(args.child, args.per_chip_batch, args.imsize, args.iters)
+        return
+
+    # Probe the backend in a throwaway subprocess so a hung TPU tunnel
+    # can't wedge the harness itself.
+    n_real, platform, probe = 0, "cpu", None
+    if not args.cpu:
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d=jax.devices(); print(d[0].platform, len(d))"],
+                capture_output=True, text=True, timeout=420)
+            if probe.returncode == 0:
+                platform = probe.stdout.split()[0]
+                n_real = int(probe.stdout.split()[1])
+        except subprocess.TimeoutExpired:
+            print("[scaling] backend probe hung; falling back to virtual CPU",
+                  file=sys.stderr, flush=True)
+            probe = None
+    if args.tpu and platform != "tpu":
+        raise SystemExit(
+            "TPU required but backend probe says: %r"
+            % ("probe timed out" if probe is None
+               else (probe.stdout or probe.stderr)))
+
+    on_tpu = platform == "tpu"
+    per_chip = args.per_chip_batch or (16 if on_tpu else 2)
+    imsize = args.imsize or (512 if on_tpu else 64)
+    iters = args.iters or (10 if on_tpu else 5)
+
+    results = []
+    for n in args.devices:
+        env = dict(os.environ)
+        use_cpu = not on_tpu or n > n_real
+        if use_cpu:
+            env["SCALING_PLATFORM"] = "cpu"
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                " --xla_force_host_platform_device_count=%d"
+                                % n).strip()
+        cmd = [sys.executable, os.path.abspath(__file__), "--child", str(n),
+               "--per-chip-batch", str(per_chip), "--imsize", str(imsize),
+               "--iters", str(iters)]
+        print("[scaling] n=%d (%s)..." % (n, "cpu-virtual" if use_cpu
+                                          else "tpu"),
+              file=sys.stderr, flush=True)
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=1200, env=env)
+        except subprocess.TimeoutExpired:
+            print("[scaling] n=%d TIMED OUT" % n, file=sys.stderr, flush=True)
+            results.append({"devices": n, "error": "timeout"})
+            continue
+        if r.returncode != 0:
+            print("[scaling] n=%d FAILED:\n%s" % (n, r.stderr[-2000:]),
+                  file=sys.stderr, flush=True)
+            results.append({"devices": n, "error": r.stderr[-500:]})
+            continue
+        results.append(json.loads(r.stdout.strip().splitlines()[-1]))
+
+    base = next((r["img_per_sec_per_chip"] for r in results
+                 if r.get("devices") == 1 and "img_per_sec_per_chip" in r),
+                None)
+    for r in results:
+        if base and "img_per_sec_per_chip" in r:
+            r["efficiency"] = round(r["img_per_sec_per_chip"] / base, 4)
+
+    out = {"per_chip_batch": per_chip, "imsize": imsize, "iters": iters,
+           "results": results}
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Worker for the 2-process jax.distributed CPU test (test_distributed.py).
+
+Each process contributes ONE virtual CPU device to a 2-device global mesh,
+runs the multi-host branch of `shard_batch` (make_array_from_process_local_data,
+parallel/mesh.py) and one sharded train step — the exact code path a real
+multi-host TPU run uses over DCN (≡ reference mp.spawn + NCCL worker,
+/root/reference/train.py:23-45).
+
+Usage: python distributed_worker.py <rank> <world> <port> <outdir>
+"""
+
+import json
+import os
+import sys
+
+rank, world, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                             int(sys.argv[3]), sys.argv[4])
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from real_time_helmet_detection_tpu.config import Config  # noqa: E402
+from real_time_helmet_detection_tpu.models import build_model  # noqa: E402
+from real_time_helmet_detection_tpu.optim import build_optimizer  # noqa: E402
+from real_time_helmet_detection_tpu.parallel import (init_distributed,  # noqa: E402
+                                                     make_mesh, shard_batch)
+from real_time_helmet_detection_tpu.train import (create_train_state,  # noqa: E402
+                                                  make_train_step)
+
+IMSIZE = 64
+GLOBAL_BATCH = 4
+
+
+def main() -> None:
+    cfg = Config(num_stack=1, hourglass_inch=16, num_cls=2,
+                 batch_size=GLOBAL_BATCH, lr=1e-3, world_size=world,
+                 rank=rank, dist_url="tcp://127.0.0.1:%d" % port)
+    init_distributed(cfg)
+    assert jax.process_count() == world, jax.process_count()
+    assert len(jax.devices()) == world
+    assert len(jax.local_devices()) == 1
+
+    mesh = make_mesh(world)
+    model = build_model(cfg)
+    tx = build_optimizer(cfg, 10)
+    state = create_train_state(model, cfg, jax.random.key(0), IMSIZE, tx)
+    step = make_train_step(model, tx, cfg, mesh)
+
+    # deterministic GLOBAL batch; this process feeds its contiguous row block
+    # (mesh device order = process order on the data axis)
+    from real_time_helmet_detection_tpu.data import synthetic_target_batch
+    g = synthetic_target_batch(GLOBAL_BATCH, IMSIZE)
+    per = GLOBAL_BATCH // world
+    local = tuple(a[rank * per:(rank + 1) * per] for a in g)
+    arrays = shard_batch(mesh, local, spatial_dims=[1] * 5)
+
+    state, losses = step(state, *arrays)
+    jax.block_until_ready(losses["total"])
+    result = {k: float(v) for k, v in losses.items()}
+    result["param0"] = float(
+        np.asarray(jax.tree.leaves(state.params)[0]).ravel()[0])
+    with open(os.path.join(outdir, "rank%d.json" % rank), "w") as f:
+        json.dump(result, f)
+    print("rank %d ok: %s" % (rank, result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Multi-process distributed training test (2 CPU processes).
+
+Executes the real multi-host path — `jax.distributed.initialize` rendezvous
+(parallel/mesh.py init_distributed) and the
+`make_array_from_process_local_data` branch of `shard_batch` — which a
+single-process suite can never reach, then checks the sharded step agrees
+with the single-process run on the same global batch (≡ reference DDP
+worker, /root/reference/train.py:23-45, whose correctness PyTorch only
+asserts implicitly).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "distributed_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_train_step_matches_single(tmp_path):
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(rank), "2", str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for rank in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    finally:
+        for p in procs:  # a wedged rendezvous must not leak workers
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, "worker failed:\n%s" % out
+
+    with open(tmp_path / "rank0.json") as f:
+        multi = json.load(f)
+    with open(tmp_path / "rank1.json") as f:
+        multi1 = json.load(f)
+    # both processes hold the same replicated result
+    assert multi["total"] == pytest.approx(multi1["total"], rel=1e-6)
+    assert multi["param0"] == pytest.approx(multi1["param0"], rel=1e-6)
+
+    # single-process reference on the identical global batch
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.data import synthetic_target_batch
+    from real_time_helmet_detection_tpu.models import build_model
+    from real_time_helmet_detection_tpu.optim import build_optimizer
+    from real_time_helmet_detection_tpu.parallel import make_mesh, shard_batch
+    from real_time_helmet_detection_tpu.train import (create_train_state,
+                                                      make_train_step)
+    import jax
+
+    IMSIZE, B = 64, 4
+    cfg = Config(num_stack=1, hourglass_inch=16, num_cls=2, batch_size=B,
+                 lr=1e-3)
+    model = build_model(cfg)
+    tx = build_optimizer(cfg, 10)
+    state = create_train_state(model, cfg, jax.random.key(0), IMSIZE, tx)
+    mesh = make_mesh(1)
+    step = make_train_step(model, tx, cfg, mesh)
+    batch = synthetic_target_batch(B, IMSIZE)
+    state, losses = step(state, *shard_batch(mesh, batch,
+                                             spatial_dims=[1] * 5))
+    single_total = float(losses["total"])
+    single_p0 = float(np.asarray(jax.tree.leaves(state.params)[0]).ravel()[0])
+
+    assert multi["total"] == pytest.approx(single_total, rel=1e-4)
+    assert multi["param0"] == pytest.approx(single_p0, rel=1e-4, abs=1e-6)
