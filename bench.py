@@ -2,19 +2,31 @@
 
 Headline reference number: 100 FPS at 512x512 on a GTX 1080 Ti via the
 TorchScript C++ app (/root/reference/README.md:76). This bench measures, on
-one chip, steady-state and device-synchronized:
+one chip, steady-state:
 
 * `inference_fps_512` (primary) — the fused predict path (network forward
   -> sigmoid -> decode -> NMS) as ONE jitted XLA program at batch 8;
-* `latency_ms_b1` — median batch-1 latency (the reference's "real-time"
+* `latency_ms_b1` — batch-1 device latency (the reference's "real-time"
   framing);
 * `train_img_per_sec_chip` — train-step throughput at the flagship config
   (batch 16, 512^2, bf16) — BASELINE.json's north-star metric;
 * `mfu_fwd` / `mfu_train` — analytic MFU from XLA's compiled cost
   analysis vs the chip's peak bf16 FLOP/s;
-* `peak_pallas_ms` / `peak_xla_ms` — the fused Pallas sigmoid+3x3-peak
+* `peak_pallas_us` / `peak_xla_us` — the fused Pallas sigmoid+3x3-peak
   kernel vs the XLA reduce_window path it replaces, plus an on-device
   bit-identity check.
+
+Measurement methodology (round-2 postmortem): on the remote-tunnel `axon`
+backend, `block_until_ready` resolves BEFORE remote execution completes and
+every materializing dispatch costs ~70 ms of tunnel round-trip — a naive
+per-call timing loop measured 5x the chip's peak FLOP/s (impossible) for
+the model and pure tunnel latency for microkernels. So every section here
+scans N iterations *inside* one jitted program (`lax.scan`/`fori_loop`)
+with a data dependency between iterations, returns only scalars, and times
+the single dispatch + host fetch of the scalar; the separately-measured
+one-dispatch overhead (`dispatch_ms`, reported) is subtracted. Validated:
+this methodology reproduces ~100% roofline on a 4096^3 bf16 matmul chain
+while the naive loop reported 890 TFLOP/s on a 197 TFLOP/s chip.
 
 Robustness (round-1 postmortem: BENCH_r01.json was rc=1 because the remote
 TPU backend failed to initialize and the bench had no handling): backend
@@ -83,15 +95,34 @@ def acquire_backend(retries: int = 3, backoff_s: float = 15.0):
     raise SystemExit("no backend available: %r" % last)
 
 
-def timed(fn, iters: int):
-    """Median and total wall time of `fn()` (already warmed up)."""
+def measure_dispatch_overhead() -> float:
+    """Median wall time of dispatching a trivial program and fetching its
+    scalar — the fixed per-call cost every scanned measurement subtracts."""
     import jax
+    import jax.numpy as jnp
+    f = jax.jit(lambda x: x + 1.0)
+    z = jnp.zeros(())
+    float(f(z))  # compile
     times = []
-    for _ in range(iters):
+    for _ in range(7):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
+        float(f(z))
         times.append(time.perf_counter() - t0)
-    return float(np.median(times)), float(np.sum(times))
+    return float(np.median(times))
+
+
+def timed_fetch(compiled, args, overhead: float, repeats: int = 2):
+    """Best-of-`repeats` wall time of one dispatch of `compiled` (which must
+    return only scalars/tiny arrays) including the fetch, minus the
+    measured dispatch overhead."""
+    import jax
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = compiled(*args)
+        jax.tree.map(np.asarray, out)  # host fetch: forces real completion
+        best = min(best, time.perf_counter() - t0)
+    return max(best - overhead, 1e-9)
 
 
 def flops_of(compiled) -> float | None:
@@ -109,6 +140,7 @@ def flops_of(compiled) -> float | None:
 def main() -> None:
     jax, devs = acquire_backend()
     import jax.numpy as jnp
+    from jax import lax
 
     platform = devs[0].platform
     device_kind = getattr(devs[0], "device_kind", "unknown")
@@ -126,7 +158,11 @@ def main() -> None:
     imsize = 512 if on_tpu else 128
     batch = 8 if on_tpu else 2
     train_batch = 16 if on_tpu else 2
-    iters = 20 if on_tpu else 5
+    # scan lengths: long enough that the ~70 ms dispatch overhead is noise
+    n_inf = 512 if on_tpu else 4
+    n_b1 = 512 if on_tpu else 4
+    n_train = 64 if on_tpu else 2
+    n_peak = 20000 if on_tpu else 20
 
     from real_time_helmet_detection_tpu.config import Config
     from real_time_helmet_detection_tpu.models import build_model
@@ -146,29 +182,48 @@ def main() -> None:
         "imsize": imsize, "batch": batch,
     }
 
+    overhead = measure_dispatch_overhead()
+    out["dispatch_ms"] = round(overhead * 1e3, 3)
+    log("dispatch overhead: %.1f ms" % (overhead * 1e3))
+
     params, batch_stats = init_variables(model, jax.random.key(0), imsize)
     variables = {"params": params, "batch_stats": batch_stats}
     predict = make_predict_fn(model, cfg)
+
+    def make_predict_chain(n):
+        """N sequential predicts in ONE program; each iteration's input
+        depends (negligibly: +score*1e-12) on the previous output so XLA
+        cannot collapse or parallelize the chain."""
+        def prog(variables, images):
+            def body(imgs, _):
+                det = predict(variables, imgs)
+                eps = (jnp.tanh(jnp.sum(det.scores)) * 1e-12).astype(
+                    imgs.dtype)
+                return imgs + eps, ()
+            final, _ = lax.scan(body, images, None, length=n)
+            return jnp.sum(final[0, 0, 0])
+        return jax.jit(prog)
 
     # --- inference throughput (primary) + MFU(fwd) ------------------------
     try:
         images = jnp.asarray(rng.standard_normal(
             (batch, imsize, imsize, 3)).astype(np.float32))
-        # predict is already jitted; lower/compile it ONCE and run the
-        # compiled executable directly (no second compile via the call cache)
-        compiled = predict.lower(variables, images).compile()
-        fwd_flops = flops_of(compiled)
-        for _ in range(3):
-            jax.block_until_ready(compiled(variables, images))
-        _, total = timed(lambda: compiled(variables, images), iters)
-        fps = batch * iters / total
+        compiled = make_predict_chain(n_inf).lower(variables, images).compile()
+        chain_flops = flops_of(compiled)
+        np.asarray(compiled(variables, images))  # warmup
+        dt = timed_fetch(compiled, (variables, images), overhead)
+        fps = batch * n_inf / dt
         out["value"] = round(fps, 2)
+        out["n_scan"] = n_inf
         # vs_baseline only against the reference's own 512^2 setting
         if imsize == 512:
             out["vs_baseline"] = round(fps / BASELINE_FPS, 3)
-        if fwd_flops:
-            out["mfu_fwd"] = round(fwd_flops * iters / total / peak, 4)
-        log("inference: %.1f img/s" % fps)
+        if chain_flops:
+            # XLA cost analysis counts a scan/while body ONCE regardless of
+            # trip count (verified empirically) -> multiply by n_inf
+            out["mfu_fwd"] = round(chain_flops * n_inf / dt / peak, 4)
+        log("inference: %.1f img/s (%.3f ms/batch-%d)"
+            % (fps, dt / n_inf * 1e3, batch))
     except Exception as e:  # noqa: BLE001
         log("inference bench failed: %r" % e)
 
@@ -176,53 +231,48 @@ def main() -> None:
     try:
         img1 = jnp.asarray(rng.standard_normal(
             (1, imsize, imsize, 3)).astype(np.float32))
-        for _ in range(3):
-            jax.block_until_ready(predict(variables, img1))
-        med, _ = timed(lambda: predict(variables, img1), iters)
-        out["latency_ms_b1"] = round(med * 1e3, 3)
-        log("batch-1 latency: %.2f ms" % (med * 1e3))
+        c1 = make_predict_chain(n_b1).lower(variables, img1).compile()
+        np.asarray(c1(variables, img1))
+        dt = timed_fetch(c1, (variables, img1), overhead)
+        out["latency_ms_b1"] = round(dt / n_b1 * 1e3, 3)
+        log("batch-1 device latency: %.3f ms" % (dt / n_b1 * 1e3))
     except Exception as e:  # noqa: BLE001
         log("latency bench failed: %r" % e)
 
     # --- train-step throughput + MFU(train) -------------------------------
     try:
         from real_time_helmet_detection_tpu.optim import build_optimizer
-        from real_time_helmet_detection_tpu.parallel import (make_mesh,
-                                                             shard_batch)
-        from real_time_helmet_detection_tpu.train import (create_train_state,
-                                                          make_train_step)
+        from real_time_helmet_detection_tpu.train import (
+            create_train_state, make_scanned_train_fn, make_train_step_body)
         tcfg = Config(num_stack=1, hourglass_inch=128, num_cls=2,
                       batch_size=train_batch, amp=dtype is not None,
                       imsize=imsize)
         tmodel = build_model(tcfg, dtype=dtype)
         tx = build_optimizer(tcfg, 100)
         state = create_train_state(tmodel, tcfg, jax.random.key(0), imsize, tx)
-        mesh = make_mesh(1)
-        step = make_train_step(tmodel, tx, tcfg, mesh)
+        body = make_train_step_body(tmodel, tx, tcfg)
         from real_time_helmet_detection_tpu.data import synthetic_target_batch
-        arrs = shard_batch(mesh, synthetic_target_batch(train_batch, imsize,
-                                                        pos_rate=0.01),
-                           spatial_dims=[1] * 5)
-        # make_train_step returns a jitted fn (donation included): compile
-        # once, reuse the executable for both cost analysis and timing
-        tcompiled = step.lower(state, *arrs).compile()
+        arrs = tuple(jnp.asarray(a) for a in synthetic_target_batch(
+            train_batch, imsize, pos_rate=0.01))
+
+        train_n = make_scanned_train_fn(body, n_train)
+        tcompiled = jax.jit(train_n, donate_argnums=(0,)).lower(
+            state, *arrs).compile()
         train_flops = flops_of(tcompiled)
-        for _ in range(2):
-            state, _ = tcompiled(state, *arrs)
-        jax.block_until_ready(state.params)
-        titers = max(5, iters // 2)
-        t0 = time.perf_counter()
-        for _ in range(titers):
-            state, losses = tcompiled(state, *arrs)
-        jax.block_until_ready(losses["total"])
-        dt = time.perf_counter() - t0
-        out["train_img_per_sec_chip"] = round(train_batch * titers / dt, 2)
+        # warmup run consumes (donates) `state`; rebuild for the timed run
+        np.asarray(tcompiled(state, *arrs)[1])
+        state = create_train_state(tmodel, tcfg, jax.random.key(0), imsize, tx)
+        dt = timed_fetch(tcompiled, (state, *arrs), overhead, repeats=1)
+        out["train_img_per_sec_chip"] = round(train_batch * n_train / dt, 2)
         out["train_batch"] = train_batch
+        out["train_step_ms"] = round(dt / n_train * 1e3, 3)
         if train_flops:
-            out["mfu_train"] = round(train_flops * titers / dt / peak, 4)
+            # scan body counted once by cost analysis -> multiply by n_train
+            out["mfu_train"] = round(train_flops * n_train / dt / peak, 4)
         out["mfu_peak_flops"] = peak
         out["mfu_peak_known"] = peak_known
-        log("train: %.1f img/s/chip" % (train_batch * titers / dt))
+        log("train: %.1f img/s/chip (%.2f ms/step)"
+            % (train_batch * n_train / dt, dt / n_train * 1e3))
     except Exception as e:  # noqa: BLE001
         log("train bench failed: %r" % e)
 
@@ -233,19 +283,31 @@ def main() -> None:
                 fused_peak_scores, peak_scores_reference)
             logits = jnp.asarray(rng.standard_normal(
                 (batch, imsize // 4, imsize // 4, 2)).astype(np.float32) * 4)
-            pall = jax.jit(jax.vmap(
-                lambda x: fused_peak_scores(x, interpret=False)))
-            xla = jax.jit(jax.vmap(peak_scores_reference))
-            a = jax.block_until_ready(pall(logits))
-            b = jax.block_until_ready(xla(logits))
+
+            def chain(fn):
+                def prog(x):
+                    def body(i, y):
+                        o = jax.vmap(fn)(y)
+                        return y + o * 1e-20
+                    return jnp.sum(lax.fori_loop(0, n_peak, body, x)[0, 0, 0])
+                return jax.jit(prog)
+
+            pall = chain(lambda x: fused_peak_scores(x, interpret=False))
+            xla = chain(peak_scores_reference)
+            a = jax.vmap(lambda x: fused_peak_scores(x, interpret=False))(
+                logits)
+            b = jax.vmap(peak_scores_reference)(logits)
             out["pallas_matches_xla"] = bool(
-                jnp.array_equal(a, b).item())
-            mp, _ = timed(lambda: pall(logits), 50)
-            mx, _ = timed(lambda: xla(logits), 50)
-            out["peak_pallas_ms"] = round(mp * 1e3, 4)
-            out["peak_xla_ms"] = round(mx * 1e3, 4)
-            log("pallas peak: %.3f ms vs xla %.3f ms (match=%s)"
-                % (mp * 1e3, mx * 1e3, out["pallas_matches_xla"]))
+                np.array_equal(np.asarray(a), np.asarray(b)))
+            cp = pall.lower(logits).compile()
+            cx = xla.lower(logits).compile()
+            np.asarray(cp(logits)), np.asarray(cx(logits))
+            tp = timed_fetch(cp, (logits,), overhead) / n_peak
+            txla = timed_fetch(cx, (logits,), overhead) / n_peak
+            out["peak_pallas_us"] = round(tp * 1e6, 3)
+            out["peak_xla_us"] = round(txla * 1e6, 3)
+            log("pallas peak: %.2f us vs xla %.2f us (match=%s)"
+                % (tp * 1e6, txla * 1e6, out["pallas_matches_xla"]))
         except Exception as e:  # noqa: BLE001
             log("pallas bench failed: %r" % e)
 

@@ -92,6 +92,9 @@ class Config:
     multiscale_flag: bool = False
     multiscale: List[int] = field(default_factory=lambda: [320, 512, 64])
     device_augment: bool = False  # augment+encode on the TPU inside the step
+    cache_device: bool = False    # stage the whole dataset in HBM once;
+    # each step gathers its batch on-device by index (single-host,
+    # requires --device-augment; for datasets that fit in HBM)
 
     # loss
     hm_weight: float = 1.0

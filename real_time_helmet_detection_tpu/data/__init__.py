@@ -2,13 +2,15 @@
 
 from .voc import CLASS2COLOR, CLASS2INDEX, INDEX2CLASS, VOCDataset
 from .augment import TestAugmentor, TrainAugmentor
-from .pipeline import Batch, BatchLoader, collate, load_dataset
+from .pipeline import (Batch, BatchLoader, DeviceDatasetCache, collate,
+                       epoch_indices, load_dataset)
 from .synthetic import make_synthetic_voc, synthetic_target_batch
 
 __all__ = [
     "CLASS2COLOR", "CLASS2INDEX", "INDEX2CLASS", "VOCDataset",
     "TestAugmentor", "TrainAugmentor",
-    "Batch", "BatchLoader", "collate", "load_dataset",
+    "Batch", "BatchLoader", "DeviceDatasetCache", "collate",
+    "epoch_indices", "load_dataset",
     "make_synthetic_voc",
     "synthetic_target_batch",
 ]

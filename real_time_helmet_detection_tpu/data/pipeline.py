@@ -36,7 +36,7 @@ from ..utils import normalize_image
 @dataclass
 class Batch:
     """One training/eval batch, channels-last numpy."""
-    image: np.ndarray     # (B, S, S, 3) float32 normalized
+    image: np.ndarray     # (B, S, S, 3) float32 normalized (raw: uint8)
     heatmap: np.ndarray   # (B, S/4, S/4, num_cls)
     offset: np.ndarray    # (B, S/4, S/4, 2)
     wh: np.ndarray        # (B, S/4, S/4, 2)
@@ -74,9 +74,9 @@ def collate(samples: Sequence, augmentor, pretrained: str = "imagenet",
     """samples: list of (img, boxes, labels, voc_dict) from `VOCDataset`.
 
     `raw=True` is the device-augment input mode: images stay un-normalized
-    float32 [0, 255] and no target maps are encoded — augmentation, GT
-    encoding and normalization all happen on the accelerator inside the
-    train step (data/augment_device.py).
+    uint8 canvases and no target maps are encoded — augmentation, GT
+    encoding, float cast and normalization all happen on the accelerator
+    inside the train step (data/augment_device.py).
     """
     imgs, boxes, labels, infos = zip(*samples)
     imgs, boxes, labels = augmentor(list(imgs), list(boxes), list(labels))
@@ -87,8 +87,11 @@ def collate(samples: Sequence, augmentor, pretrained: str = "imagenet",
     pb, pl, pv = np.stack(pb), np.stack(pl), np.stack(pv)
 
     if raw:
+        # uint8 on the wire: the augmentors return uint8 canvases and the
+        # fused device step casts to float32 on-chip — shipping float32
+        # would quadruple host->device traffic for identical bits
         empty = np.zeros((len(imgs), 0, 0, 0), np.float32)
-        return Batch(image=np.stack(imgs).astype(np.float32), heatmap=empty,
+        return Batch(image=np.stack(imgs), heatmap=empty,
                      offset=empty, wh=empty, mask=empty, boxes=pb, labels=pl,
                      valid=pv, infos=list(infos))
 
@@ -111,6 +114,25 @@ def collate(samples: Sequence, augmentor, pretrained: str = "imagenet",
     image = np.stack([normalize_image(im, pretrained) for im in imgs])
     return Batch(image=image, heatmap=heat, offset=off, wh=wh, mask=mask,
                  boxes=pb, labels=pl, valid=pv, infos=list(infos))
+
+
+def epoch_indices(n: int, seed: int, epoch: int, shuffle: bool = True,
+                  rank: int = 0, world_size: int = 1) -> np.ndarray:
+    """The (seed, epoch)-keyed permutation + per-host shard both the host
+    `BatchLoader` and the HBM `DeviceDatasetCache` draw batches from — one
+    definition so the two input paths see identical batch composition
+    (the `DistributedSampler` contract, ref train.py:54, 67)."""
+    idx = np.arange(n)
+    if shuffle:
+        rng = np.random.default_rng(seed + epoch)
+        idx = rng.permutation(idx)
+    # Pad by wrapping so every host gets the same number of samples —
+    # required for SPMD lockstep (every host must issue the same number
+    # of collectives per epoch); same policy as DistributedSampler.
+    total = -(-len(idx) // world_size) * world_size
+    if total > len(idx) and len(idx) > 0:
+        idx = np.concatenate([idx, idx[:total - len(idx)]])
+    return idx[rank::world_size]
 
 
 class BatchLoader:
@@ -147,17 +169,9 @@ class BatchLoader:
         self.epoch = epoch
 
     def _indices(self) -> np.ndarray:
-        idx = np.arange(len(self.dataset))
-        if self.shuffle:
-            rng = np.random.default_rng(self.seed + self.epoch)
-            idx = rng.permutation(idx)
-        # Pad by wrapping so every host gets the same number of samples —
-        # required for SPMD lockstep (every host must issue the same number
-        # of collectives per epoch); same policy as DistributedSampler.
-        total = -(-len(idx) // self.world_size) * self.world_size
-        if total > len(idx) and len(idx) > 0:
-            idx = np.concatenate([idx, idx[:total - len(idx)]])
-        return idx[self.rank::self.world_size]
+        return epoch_indices(len(self.dataset), self.seed, self.epoch,
+                             shuffle=self.shuffle, rank=self.rank,
+                             world_size=self.world_size)
 
     def __len__(self) -> int:
         n = len(self._indices())
@@ -210,6 +224,89 @@ class BatchLoader:
                 yield item
         finally:
             stop.set()
+
+
+class DeviceDatasetCache:
+    """Device-resident dataset for `--cache-device` training.
+
+    The reference's answer to input-bound training is more DataLoader
+    workers (ref train.py:39 `num_workers`); the TPU-native answer for any
+    dataset that fits in HBM is to stop streaming altogether: decode +
+    canvas-resize every sample ONCE, stage the raw uint8 canvases and
+    padded box arrays in device memory, and let each train step **gather
+    its batch on-device** from a host-sent index vector (B int32 values —
+    tens of bytes/step instead of tens of MB/step). Augmentation still
+    happens per-step on-chip (data/augment_device.py), so epochs see fresh
+    randomness; only the decoded pixels are frozen.
+
+    SHWD itself fits easily: 7581 images x 512^2 x 3 uint8 = 5.7 GiB on a
+    16 GiB v5e. Single-host only (each host would need its own shard);
+    `train()` validates that.
+
+    Iterating yields `(B,)` int32 index arrays; batch composition is
+    identical to `BatchLoader` (shared `epoch_indices`). `augmentor` must
+    be deterministic per-sample (train() passes `TestAugmentor`; random
+    augmentation belongs on-device, per step).
+    """
+
+    def __init__(self, dataset, augmentor, batch_size: int,
+                 max_boxes: int = 128, shuffle: bool = True,
+                 drop_last: bool = True, seed: int = 777,
+                 num_workers: int = 4, mesh=None):
+        import jax
+
+        def load_one(i):
+            # decode + canvas-resize + pad inside the worker: only the
+            # uint8 canvas survives, so peak host memory is bounded by the
+            # canvases, not the full-resolution decodes
+            img, bx, lb, info = dataset[i]
+            (img,), (bx,), (lb,) = augmentor([img], [bx], [lb])
+            return (img, *pad_boxes(bx, lb, max_boxes), info)
+
+        with ThreadPoolExecutor(max(1, num_workers)) as pool:
+            samples = list(pool.map(load_one, range(len(dataset))))
+        imgs, pb, pl, pv, self.infos = zip(*samples)
+        sharding = None
+        if mesh is not None:
+            from ..parallel import replicated
+            sharding = replicated(mesh)
+
+        def put(x):
+            return (jax.device_put(x, sharding) if sharding is not None
+                    else jax.device_put(x))
+
+        # uint8 canvases: 4x the HBM capacity of float32, and exact — the
+        # host augmentors return uint8, the raw loader path merely casts.
+        self.images = put(np.stack(imgs))
+        self.boxes = put(np.stack(pb))
+        self.labels = put(np.stack(pl))
+        self.valid = put(np.stack(pv))
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        n = int(self.images.shape[0])
+        return (n // self.batch_size if self.drop_last
+                else -(-n // self.batch_size))
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        idx = epoch_indices(int(self.images.shape[0]), self.seed, self.epoch,
+                            shuffle=self.shuffle)
+        if not self.drop_last and len(idx) % self.batch_size:
+            # pad the final chunk by wrapping: the jitted cached step is
+            # fixed-shape, and a short index vector would also break the
+            # data-axis sharding divisibility
+            pad = self.batch_size - len(idx) % self.batch_size
+            idx = np.concatenate([idx, idx[:pad]])
+        for i in range(len(self)):
+            yield idx[i * self.batch_size:(i + 1) * self.batch_size].astype(
+                np.int32)
 
 
 def load_dataset(cfg, rng: Optional[np.random.Generator] = None):

@@ -109,13 +109,13 @@ def loss_fn(params, batch_stats, model, images, gt_heat, gt_off, gt_wh, mask,
     return totals["total"], (mutated.get("batch_stats", batch_stats), totals)
 
 
-def make_train_step(model, tx, cfg: Config, mesh):
-    """Build the jitted, mesh-partitioned train step.
+def make_train_step_body(model, tx, cfg: Config):
+    """The un-jitted train-step body: fwd + bwd + optimizer update.
 
-    Batch arrays are sharded (data[, spatial]); state is replicated. The
-    gradient all-reduce the reference gets from DDP hooks
-    (ref train.py:174-175) falls out of GSPMD partitioning here.
-    """
+    Exposed separately from `make_train_step` so callers that need the step
+    *inside* another XLA program (bench.py scans N steps in one dispatch to
+    time steady-state compute without per-dispatch overhead) can reuse the
+    exact production step."""
     def step(state: TrainState, images, gt_heat, gt_off, gt_wh, mask):
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         (_, (batch_stats, losses)), grads = grad_fn(
@@ -128,6 +128,36 @@ def make_train_step(model, tx, cfg: Config, mesh):
                                   opt_state=opt_state)
         return new_state, losses
 
+    return step
+
+
+def make_scanned_train_fn(body, n: int):
+    """`n` sequential train steps inside ONE XLA program (`lax.scan` over a
+    `make_train_step_body` step), returning only scalars (final step
+    counter, last total loss).
+
+    The single timing harness both bench.py and scaling.py jit: dispatching
+    one program keeps per-call overhead out of the measurement — on the
+    remote-TPU tunnel each materializing dispatch costs ~70 ms and
+    `block_until_ready` resolves before remote execution completes, so a
+    naive per-step loop measures nothing real."""
+    def train_n(state, images, heat, off, wh, mask):
+        def sbody(st, _):
+            st, losses = body(st, images, heat, off, wh, mask)
+            return st, losses["total"]
+        st, totals = jax.lax.scan(sbody, state, None, length=n)
+        return st.step, totals[-1]
+    return train_n
+
+
+def make_train_step(model, tx, cfg: Config, mesh):
+    """Build the jitted, mesh-partitioned train step.
+
+    Batch arrays are sharded (data[, spatial]); state is replicated. The
+    gradient all-reduce the reference gets from DDP hooks
+    (ref train.py:174-175) falls out of GSPMD partitioning here.
+    """
+    step = make_train_step_body(model, tx, cfg)
     repl = replicated(mesh)
     # Shardings: state fully replicated; image NHWC and target maps shard
     # (data on B, spatial on H).
@@ -140,12 +170,11 @@ def make_train_step(model, tx, cfg: Config, mesh):
         donate_argnums=(0,))
 
 
-def make_device_train_step(model, tx, cfg: Config, mesh, target: int):
-    """Train step with the input pipeline fused in: on-device augmentation,
-    GT encoding and normalization followed by fwd/bwd/update — ONE XLA
-    program per multiscale bucket. The host only decodes JPEGs and resizes
-    to the canvas (data/augment_device.py; ≡ imgaug + box2hm + normalize of
-    ref data.py:93-125 moved onto the accelerator)."""
+def make_device_step_body(model, tx, cfg: Config, target: int):
+    """Un-jitted fused-input step: on-device augmentation, GT encoding and
+    normalization followed by fwd/bwd/update. Shared by the streaming
+    (`make_device_train_step`) and HBM-cached (`make_cached_device_train_
+    step`) input paths."""
     from .data.augment_device import augment_encode_batch
     from .utils import normalizer_stats
 
@@ -155,7 +184,8 @@ def make_device_train_step(model, tx, cfg: Config, mesh, target: int):
 
     def step(state: TrainState, key, images, boxes, labels, valid):
         img, heat, off, wh, mask, _, _ = augment_encode_batch(
-            key, images, boxes, labels, valid, target=target,
+            key, images.astype(jnp.float32), boxes, labels, valid,
+            target=target,
             scale_factor=cfg.scale_factor, num_cls=cfg.num_cls,
             normalized=cfg.normalized_coord,
             crop_percent=tuple(cfg.crop_percent),
@@ -173,6 +203,16 @@ def make_device_train_step(model, tx, cfg: Config, mesh, target: int):
                              batch_stats=batch_stats,
                              opt_state=opt_state), losses
 
+    return step
+
+
+def make_device_train_step(model, tx, cfg: Config, mesh, target: int):
+    """Train step with the input pipeline fused in: on-device augmentation,
+    GT encoding and normalization followed by fwd/bwd/update — ONE XLA
+    program per multiscale bucket. The host only decodes JPEGs and resizes
+    to the canvas (data/augment_device.py; ≡ imgaug + box2hm + normalize of
+    ref data.py:93-125 moved onto the accelerator)."""
+    step = make_device_step_body(model, tx, cfg, target)
     repl = replicated(mesh)
     img_sh = batch_sharding(mesh, 4)     # gather-based warp: no spatial shard
     box_sh = batch_sharding(mesh, 3)
@@ -180,6 +220,38 @@ def make_device_train_step(model, tx, cfg: Config, mesh, target: int):
     return jax.jit(step,
                    in_shardings=(repl, repl, img_sh, box_sh, lab_sh, lab_sh),
                    out_shardings=(repl, repl), donate_argnums=(0,))
+
+
+def make_cached_device_train_step(model, tx, cfg: Config, mesh, target: int,
+                                  cache):
+    """Fused step over the HBM-resident dataset (`--cache-device`): the
+    host sends only a `(B,)` int32 index vector per step; the batch is
+    gathered from the replicated device cache, then augmented/encoded/
+    trained exactly as the streaming path (same `make_device_step_body`).
+
+    Steady-state host->device traffic: B*4 bytes instead of the
+    ~B*canvas^2*3 raw pixels of the streaming path — the input pipeline
+    cannot be the bottleneck at any batch size."""
+    body = make_device_step_body(model, tx, cfg, target)
+
+    def step(state: TrainState, key, images_all, boxes_all, labels_all,
+             valid_all, idx):
+        gather = lambda a: jnp.take(a, idx, axis=0)  # noqa: E731
+        return body(state, key, gather(images_all), gather(boxes_all),
+                    gather(labels_all), gather(valid_all))
+
+    repl = replicated(mesh)
+    idx_sh = batch_sharding(mesh, 1)
+    jitted = jax.jit(step,
+                     in_shardings=(repl, repl, repl, repl, repl, repl,
+                                   idx_sh),
+                     out_shardings=(repl, repl), donate_argnums=(0,))
+
+    def run(state, key, idx):
+        return jitted(state, key, cache.images, cache.boxes, cache.labels,
+                      cache.valid, idx)
+
+    return run
 
 
 def save_checkpoint(save_path: str, epoch: int, state: TrainState,
@@ -292,7 +364,7 @@ def make_snapshot_fn(model, cfg: Config):
     return snapshot
 
 
-def make_step_runner(cfg: Config, mesh, model, tx):
+def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
     """Build `runner(state, batch, step_idx) -> (state, losses)` for the
     configured input path.
 
@@ -300,6 +372,8 @@ def make_step_runner(cfg: Config, mesh, model, tx):
     calls the plain train step. Device path (`--device-augment`): runner
     shards raw canvases + padded boxes and calls the fused
     augment+encode+train step, one jit cache entry per multiscale bucket.
+    Cached path (`--cache-device`): `batch` is a host index vector; the
+    fused step gathers the batch from the HBM-resident `cache`.
     """
     if not cfg.device_augment:
         step = make_train_step(model, tx, cfg, mesh)
@@ -318,11 +392,29 @@ def make_step_runner(cfg: Config, mesh, model, tx):
     base_key = jax.random.key(cfg.random_seed + 2)
     steps = {}  # target -> fused jitted step (bucketed multiscale)
 
-    def runner(state, batch, step_idx):
+    def pick_target(step_idx: int) -> int:
         # keyed on (seed, global step): resume-deterministic, unlike a
         # stateful generator that restarts its stream on every process
-        target = int(np.random.default_rng(
+        return int(np.random.default_rng(
             (cfg.random_seed, step_idx)).choice(sizes))
+
+    if cache is not None:
+        idx_sharding = batch_sharding(mesh, 1)
+
+        def runner(state, idx_batch, step_idx):
+            target = pick_target(step_idx)
+            if target not in steps:
+                steps[target] = make_cached_device_train_step(
+                    model, tx, cfg, mesh, target, cache)
+            key = jax.random.fold_in(base_key, step_idx)
+            idx = jax.device_put(np.asarray(idx_batch, np.int32),
+                                 idx_sharding)
+            return steps[target](state, key, idx)
+
+        return runner
+
+    def runner(state, batch, step_idx):
+        target = pick_target(step_idx)
         if target not in steps:
             steps[target] = make_device_train_step(model, tx, cfg, mesh,
                                                    target)
@@ -424,14 +516,32 @@ def train(cfg: Config) -> TrainState:
         # augmentation + GT encode run on-device inside the fused step
         from .data import TestAugmentor
         augmentor = TestAugmentor(imsize=cfg.multiscale[1])
-    loader = BatchLoader(
-        dataset, augmentor, batch_size=cfg.batch_size // jax.process_count(),
-        pretrained=cfg.pretrained, num_cls=cfg.num_cls,
-        normalized_coord=cfg.normalized_coord, scale_factor=cfg.scale_factor,
-        max_boxes=cfg.max_boxes, shuffle=True, drop_last=True,
-        rank=jax.process_index(), world_size=jax.process_count(),
-        seed=cfg.random_seed, num_workers=cfg.num_workers,
-        raw=cfg.device_augment)
+    cache = None
+    if cfg.cache_device:
+        if not cfg.device_augment:
+            raise ValueError("--cache-device requires --device-augment "
+                             "(augmentation must run on-device; the cache "
+                             "holds un-augmented canvases)")
+        if jax.process_count() > 1:
+            raise ValueError("--cache-device is single-host only (each "
+                             "host would need its own dataset shard)")
+        from .data import DeviceDatasetCache
+        cache = DeviceDatasetCache(
+            dataset, augmentor, batch_size=cfg.batch_size,
+            max_boxes=cfg.max_boxes, shuffle=True, drop_last=True,
+            seed=cfg.random_seed, num_workers=cfg.num_workers, mesh=mesh)
+        loader = cache
+    else:
+        loader = BatchLoader(
+            dataset, augmentor,
+            batch_size=cfg.batch_size // jax.process_count(),
+            pretrained=cfg.pretrained, num_cls=cfg.num_cls,
+            normalized_coord=cfg.normalized_coord,
+            scale_factor=cfg.scale_factor,
+            max_boxes=cfg.max_boxes, shuffle=True, drop_last=True,
+            rank=jax.process_index(), world_size=jax.process_count(),
+            seed=cfg.random_seed, num_workers=cfg.num_workers,
+            raw=cfg.device_augment)
     steps_per_epoch = max(1, len(loader))
 
     dtype = jnp.bfloat16 if cfg.amp else None
@@ -449,7 +559,7 @@ def train(cfg: Config) -> TrainState:
             print("%s: resumed from %s (epoch %d)"
                   % (timestamp(), cfg.model_load, ckpt_epoch), flush=True)
 
-    runner = make_step_runner(cfg, mesh, model, tx)
+    runner = make_step_runner(cfg, mesh, model, tx, cache=cache)
     snapshot_fn = (make_snapshot_fn(model, cfg)
                    if is_chief and not cfg.device_augment else None)
     if is_chief:

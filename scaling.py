@@ -30,17 +30,29 @@ import time
 
 
 def child(n: int, per_chip_batch: int, imsize: int, iters: int) -> None:
-    """Measure one device count; prints a single JSON line."""
+    """Measure one device count; prints a single JSON line.
+
+    Timing methodology matches bench.py: `iters` steps are scanned INSIDE
+    one jitted program (state carried between steps) and only scalars come
+    back, so the measurement is pure device time — per-dispatch overhead
+    (which on the remote-TPU tunnel is ~70 ms and on which
+    `block_until_ready` resolves before execution finishes) never enters.
+    The separately-measured single-dispatch overhead is subtracted."""
     import jax
+    import numpy as np
     if os.environ.get("SCALING_PLATFORM") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     from real_time_helmet_detection_tpu.config import Config
     from real_time_helmet_detection_tpu.data import synthetic_target_batch
     from real_time_helmet_detection_tpu.models import build_model
     from real_time_helmet_detection_tpu.optim import build_optimizer
-    from real_time_helmet_detection_tpu.parallel import make_mesh, shard_batch
+    from real_time_helmet_detection_tpu.parallel import (batch_sharding,
+                                                         make_mesh,
+                                                         replicated,
+                                                         shard_batch)
     from real_time_helmet_detection_tpu.train import (create_train_state,
-                                                      make_train_step)
+                                                      make_scanned_train_fn,
+                                                      make_train_step_body)
 
     batch = n * per_chip_batch
     cfg = Config(num_stack=1,
@@ -50,20 +62,25 @@ def child(n: int, per_chip_batch: int, imsize: int, iters: int) -> None:
     tx = build_optimizer(cfg, 100)
     state = create_train_state(model, cfg, jax.random.key(0), imsize, tx)
     mesh = make_mesh(n)
-    step = make_train_step(model, tx, cfg, mesh)
+    body = make_train_step_body(model, tx, cfg)
+
+    train_n = make_scanned_train_fn(body, iters)
+    repl = replicated(mesh)
+    map_sh = batch_sharding(mesh, 4, spatial_dim=1)
+    step = jax.jit(train_n,
+                   in_shardings=(repl,) + (map_sh,) * 5,
+                   out_shardings=(repl, repl))
 
     arrs = shard_batch(mesh, synthetic_target_batch(batch, imsize,
                                                     pos_rate=0.01),
                        spatial_dims=[1] * 5)
 
-    for _ in range(2):  # compile + settle
-        state, losses = step(state, *arrs)
-    jax.block_until_ready(losses["total"])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, losses = step(state, *arrs)
-    jax.block_until_ready(losses["total"])
-    dt = time.perf_counter() - t0
+    # shared timing helpers: one validated methodology (see bench.py)
+    from bench import measure_dispatch_overhead, timed_fetch
+    overhead = measure_dispatch_overhead()
+
+    np.asarray(step(state, *arrs)[1])  # compile + warm
+    dt = timed_fetch(step, (state, *arrs), overhead, repeats=1)
     print(json.dumps({
         "devices": n, "platform": jax.devices()[0].platform,
         "img_per_sec": round(batch * iters / dt, 2),
