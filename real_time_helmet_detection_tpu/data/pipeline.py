@@ -255,17 +255,33 @@ class DeviceDatasetCache:
                  num_workers: int = 4, mesh=None):
         import jax
 
+        n = len(dataset)
+        probe_img, probe_bx, probe_lb, _ = dataset[0]
+        (probe_img,), _, _ = augmentor([probe_img], [probe_bx], [probe_lb])
+        canvas = probe_img.shape[0]
+        # Preallocate and let workers write their slot in place: exactly
+        # ONE host copy of the canvases exists at any time (SHWD at 512^2
+        # is 5.7 GiB — a transient second copy could OOM the host).
+        # uint8 canvases: 4x the HBM capacity of float32, and exact — the
+        # host augmentors return uint8, the raw loader path merely casts.
+        images = np.empty((n, canvas, canvas, 3), np.uint8)
+        boxes = np.zeros((n, max_boxes, 4), np.float32)
+        labels = np.zeros((n, max_boxes), np.int32)
+        valid = np.zeros((n, max_boxes), bool)
+        self.infos = [None] * n
+
         def load_one(i):
             # decode + canvas-resize + pad inside the worker: only the
             # uint8 canvas survives, so peak host memory is bounded by the
             # canvases, not the full-resolution decodes
             img, bx, lb, info = dataset[i]
             (img,), (bx,), (lb,) = augmentor([img], [bx], [lb])
-            return (img, *pad_boxes(bx, lb, max_boxes), info)
+            images[i] = img
+            boxes[i], labels[i], valid[i] = pad_boxes(bx, lb, max_boxes)
+            self.infos[i] = info
 
         with ThreadPoolExecutor(max(1, num_workers)) as pool:
-            samples = list(pool.map(load_one, range(len(dataset))))
-        imgs, pb, pl, pv, self.infos = zip(*samples)
+            list(pool.map(load_one, range(n)))
         sharding = None
         if mesh is not None:
             from ..parallel import replicated
@@ -275,12 +291,10 @@ class DeviceDatasetCache:
             return (jax.device_put(x, sharding) if sharding is not None
                     else jax.device_put(x))
 
-        # uint8 canvases: 4x the HBM capacity of float32, and exact — the
-        # host augmentors return uint8, the raw loader path merely casts.
-        self.images = put(np.stack(imgs))
-        self.boxes = put(np.stack(pb))
-        self.labels = put(np.stack(pl))
-        self.valid = put(np.stack(pv))
+        self.images = put(images)
+        self.boxes = put(boxes)
+        self.labels = put(labels)
+        self.valid = put(valid)
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last
