@@ -70,7 +70,9 @@ def evaluate(cfg: Config) -> Dict:
     from .metrics import compute_map, write_detection_txt
 
     model, variables = load_eval_state(cfg)
-    predict = make_predict_fn(model, cfg)
+    # raw wire: images ship as uint8 canvases and are normalized on-device
+    # inside the jitted predict program (see make_predict_fn)
+    predict = make_predict_fn(model, cfg, normalize=cfg.pretrained)
 
     dataset, augmentor = load_dataset(cfg)
     loader = BatchLoader(dataset, augmentor, batch_size=cfg.batch_size,
@@ -78,7 +80,8 @@ def evaluate(cfg: Config) -> Dict:
                          normalized_coord=cfg.normalized_coord,
                          scale_factor=cfg.scale_factor,
                          max_boxes=cfg.max_boxes, shuffle=False,
-                         drop_last=False, num_workers=cfg.num_workers)
+                         drop_last=False, num_workers=cfg.num_workers,
+                         raw=True)
 
     txt_dir = os.path.join(cfg.save_path, "results", "txt")
     results: Dict[str, Dict] = {}

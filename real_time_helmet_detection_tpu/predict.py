@@ -28,13 +28,24 @@ from .ops.nms import nms_mask, soft_nms_mask
 from .ops.pallas import fused_peak_scores
 
 
-def make_predict_fn(model, cfg) -> Callable:
+def make_predict_fn(model, cfg, normalize: str | None = None) -> Callable:
     """Build `predict(variables, images) -> Detections` (batched, jitted).
 
-    images: (B, H, W, 3) normalized float32. Returns `Detections` with
-    leading batch dim and N = num_stack * topk entries per image; `valid`
-    combines the conf threshold and the NMS keep mask.
+    images: (B, H, W, 3) normalized float32 — or, when `normalize` names a
+    stats set ("imagenet"/"scratch"), raw un-normalized pixels (uint8 or
+    float [0, 255]) that are cast + normalized INSIDE the program. The eval
+    driver uses the latter so images cross the host->device boundary as
+    uint8 (4x less traffic, same bits: the host path merely casts the
+    augmentor's uint8 canvases before normalizing).
+
+    Returns `Detections` with leading batch dim and N = num_stack * topk
+    entries per image; `valid` combines the conf threshold and the NMS
+    keep mask.
     """
+    if normalize is not None:
+        from .utils import normalizer_stats
+        norm_mean, norm_std = (jnp.asarray(s) for s in
+                               normalizer_stats(normalize))
     num_cls = int(cfg.num_cls)
     topk = int(cfg.topk)
     conf_th = float(cfg.conf_th)
@@ -78,6 +89,9 @@ def make_predict_fn(model, cfg) -> Callable:
 
     @jax.jit
     def predict(variables, images: jax.Array) -> Detections:
+        if normalize is not None:
+            images = (images.astype(jnp.float32) / 255.0 - norm_mean) \
+                / norm_std
         out = model.apply(variables, images, train=False)  # (B, S, H, W, C+4)
         b, s = out.shape[0], out.shape[1]
         dets = jax.vmap(jax.vmap(decode_one))(out)          # (B, S, topk, ...)

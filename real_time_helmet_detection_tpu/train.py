@@ -182,7 +182,11 @@ def make_device_step_body(model, tx, cfg: Config, target: int):
     mean = jnp.asarray(mean)
     std = jnp.asarray(std)
 
-    def step(state: TrainState, key, images, boxes, labels, valid):
+    def step(state: TrainState, key, step_idx, images, boxes, labels, valid):
+        # per-step randomness derived INSIDE the program: the host passes
+        # the constant base key + a scalar step index instead of folding on
+        # the host (which would dispatch an extra device op per step)
+        key = jax.random.fold_in(key, step_idx)
         img, heat, off, wh, mask, _, _ = augment_encode_batch(
             key, images.astype(jnp.float32), boxes, labels, valid,
             target=target,
@@ -218,7 +222,8 @@ def make_device_train_step(model, tx, cfg: Config, mesh, target: int):
     box_sh = batch_sharding(mesh, 3)
     lab_sh = batch_sharding(mesh, 2)
     return jax.jit(step,
-                   in_shardings=(repl, repl, img_sh, box_sh, lab_sh, lab_sh),
+                   in_shardings=(repl, repl, repl, img_sh, box_sh, lab_sh,
+                                 lab_sh),
                    out_shardings=(repl, repl), donate_argnums=(0,))
 
 
@@ -234,22 +239,23 @@ def make_cached_device_train_step(model, tx, cfg: Config, mesh, target: int,
     cannot be the bottleneck at any batch size."""
     body = make_device_step_body(model, tx, cfg, target)
 
-    def step(state: TrainState, key, images_all, boxes_all, labels_all,
-             valid_all, idx):
+    def step(state: TrainState, key, step_idx, images_all, boxes_all,
+             labels_all, valid_all, idx):
         gather = lambda a: jnp.take(a, idx, axis=0)  # noqa: E731
-        return body(state, key, gather(images_all), gather(boxes_all),
-                    gather(labels_all), gather(valid_all))
+        return body(state, key, step_idx, gather(images_all),
+                    gather(boxes_all), gather(labels_all),
+                    gather(valid_all))
 
     repl = replicated(mesh)
     idx_sh = batch_sharding(mesh, 1)
     jitted = jax.jit(step,
-                     in_shardings=(repl, repl, repl, repl, repl, repl,
+                     in_shardings=(repl, repl, repl, repl, repl, repl, repl,
                                    idx_sh),
                      out_shardings=(repl, repl), donate_argnums=(0,))
 
-    def run(state, key, idx):
-        return jitted(state, key, cache.images, cache.boxes, cache.labels,
-                      cache.valid, idx)
+    def run(state, key, step_idx, idx):
+        return jitted(state, key, step_idx, cache.images, cache.boxes,
+                      cache.labels, cache.valid, idx)
 
     return run
 
@@ -398,18 +404,19 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
         return int(np.random.default_rng(
             (cfg.random_seed, step_idx)).choice(sizes))
 
-    if cache is not None:
-        idx_sharding = batch_sharding(mesh, 1)
+    # base key staged on device once; per-step fold_in happens inside the
+    # jitted step (host passes only a scalar step index with the call — no
+    # extra per-step dispatches, which cost ~70 ms each on a remote tunnel)
+    base_key = jax.device_put(base_key, replicated(mesh))
 
+    if cache is not None:
         def runner(state, idx_batch, step_idx):
             target = pick_target(step_idx)
             if target not in steps:
                 steps[target] = make_cached_device_train_step(
                     model, tx, cfg, mesh, target, cache)
-            key = jax.random.fold_in(base_key, step_idx)
-            idx = jax.device_put(np.asarray(idx_batch, np.int32),
-                                 idx_sharding)
-            return steps[target](state, key, idx)
+            return steps[target](state, base_key, np.int32(step_idx),
+                                 np.asarray(idx_batch, np.int32))
 
         return runner
 
@@ -418,10 +425,10 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
         if target not in steps:
             steps[target] = make_device_train_step(model, tx, cfg, mesh,
                                                    target)
-        key = jax.random.fold_in(base_key, step_idx)
         images, boxes, labels, valid = shard_batch(
             mesh, (batch.image, batch.boxes, batch.labels, batch.valid))
-        return steps[target](state, key, images, boxes, labels, valid)
+        return steps[target](state, base_key, np.int32(step_idx), images,
+                             boxes, labels, valid)
 
     return runner
 
@@ -435,6 +442,22 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
     meters = {k: AverageMeter() for k in ("data", "step")}
     loader.set_epoch(epoch)
     profiling = False
+    # Losses stay on device between print intervals: a per-step device_get
+    # would force a host<->device sync every step, breaking async dispatch
+    # (and costing a ~70 ms round trip per step on a remote tunnel). The
+    # pending scalars are fetched in one call every print_interval steps on
+    # EVERY host — the periodic sync both bounds the in-flight dispatch
+    # queue (each queued step pins its batch buffers in device memory) and
+    # keeps per-interval AVERAGE step times honest: the flush runs inside
+    # the timed window, so its iteration absorbs the device wait for the
+    # whole interval.
+    pending: list = []
+
+    def flush_losses():
+        for fetched in jax.device_get(pending):
+            loss_log.append(fetched)
+        pending.clear()
+
     tic = time.time()
     for i, batch in enumerate(loader):
         data_t = time.time() - tic
@@ -446,12 +469,14 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
             profiling = True
 
         state, losses = step_runner(state, batch, epoch_base_step + i)
-        losses = jax.device_get(losses)
-        loss_log.append(losses)
+        pending.append(losses)
+        if i % cfg.print_interval == 0:
+            flush_losses()
         meters["step"].update(time.time() - tic - data_t)
 
         if profiling and i >= 7:
-            jax.profiler.stop_trace()
+            flush_losses()  # completion barrier: the trace must contain
+            jax.profiler.stop_trace()  # the profiled steps, not their queue
             profiling = False
             print("%s: profiler trace -> %s" % (
                 timestamp(), os.path.join(cfg.save_path, "trace")), flush=True)
@@ -477,6 +502,7 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
                     blend_heatmap(batch.image, pred, cfg.pretrained).save(
                         os.path.join(snapshot_dir, f"e{epoch}_i{i}_pred.png"))
         tic = time.time()
+    flush_losses()
     if profiling:  # short epoch: close the trace cleanly
         jax.profiler.stop_trace()
     return state

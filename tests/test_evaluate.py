@@ -156,3 +156,25 @@ def test_overfit_learns(tmp_path):
     m = evaluate(tiny_cfg(train_flag=False, data=root, save_path=save,
                           model_load=ckpt, imsize=64))
     assert m["map"] > 0.15, m
+
+
+def test_raw_wire_predict_matches_normalized():
+    """Eval's uint8-wire path (on-device normalization inside predict) must
+    agree with host-side normalization on the same pixels."""
+    from real_time_helmet_detection_tpu.utils import normalize_image
+
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    rng = np.random.default_rng(0)
+    raw = rng.integers(0, 255, (2, 64, 64, 3), dtype=np.uint8)
+    normed = np.stack([normalize_image(im, "imagenet") for im in raw])
+    variables = model.init(jax.random.key(0), jnp.asarray(normed),
+                           train=False)
+
+    d_host = jax.device_get(make_predict_fn(model, cfg)(
+        variables, jnp.asarray(normed)))
+    d_raw = jax.device_get(make_predict_fn(model, cfg, normalize="imagenet")(
+        variables, jnp.asarray(raw)))
+    np.testing.assert_allclose(d_raw.scores, d_host.scores, atol=1e-5)
+    np.testing.assert_allclose(d_raw.boxes, d_host.boxes, atol=1e-3)
+    np.testing.assert_array_equal(d_raw.classes, d_host.classes)

@@ -325,3 +325,30 @@ def test_bf16_policy_step_runs():
     state, losses = step(state, *batch)
     assert losses["total"].dtype == jnp.float32
     assert np.isfinite(float(losses["total"]))
+
+
+def test_scanned_train_fn_matches_sequential_steps():
+    """The bench/scaling timing harness (`make_scanned_train_fn`) must run
+    the EXACT production step: N scanned steps == N sequential
+    `make_train_step_body` calls (same final step counter, same last loss,
+    same params)."""
+    from real_time_helmet_detection_tpu.train import (make_scanned_train_fn,
+                                                      make_train_step_body)
+
+    cfg = tiny_cfg()
+    model, tx, state = make_state(cfg)
+    body = make_train_step_body(model, tx, cfg)
+    batch = tuple(jnp.asarray(a) for a in synthetic_batch())
+
+    seq_state = state
+    seq_losses = []
+    for _ in range(3):
+        seq_state, losses = jax.jit(body)(seq_state, *batch)
+        seq_losses.append(float(losses["total"]))
+
+    scanned = jax.jit(make_scanned_train_fn(body, 3))
+    n_steps, last_total = scanned(state, *batch)
+    assert int(n_steps) == int(seq_state.step) == 3
+    # one fused scan program vs three separate programs: XLA reassociates
+    # float reductions differently, so equality is semantic, not bitwise
+    assert float(last_total) == pytest.approx(seq_losses[-1], rel=1e-3)
