@@ -130,6 +130,10 @@ class Config:
 
     # log
     print_interval: int = 100
+    ckpt_interval: int = 1        # checkpoint every N epochs (final epoch
+    # always saved); the reference saves every epoch (its train.py:76)
+    remat: bool = False           # rematerialize hourglass stacks in bwd
+    # (trade FLOPs for HBM: fits num-stack=4 @ 768^2 batches)
     save_path: str = "./WEIGHTS/"
     profile: bool = False         # jax.profiler trace of early train steps
 

@@ -277,6 +277,7 @@ class StackedHourglass(nn.Module):
     neck_pool: str = "None"
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
+    remat: bool = False  # rematerialize each Hourglass stack in backward
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -286,12 +287,21 @@ class StackedHourglass(nn.Module):
         x = PreLayer(mid_ch=128, out_ch=self.in_ch, activation=self.activation,
                      pool=self.pool, **kw)(x, train)
 
+        # --remat trades FLOPs for HBM: each stack's activations are
+        # recomputed during backward instead of stored — the lever that
+        # fits num_stack=4 @ 768^2 batches in memory (BASELINE config #4);
+        # numerically identical (tested). The explicit name keeps the param
+        # tree identical to the plain model, so checkpoints are
+        # interchangeable between --remat and stored-activation runs.
+        HG = (nn.remat(Hourglass, static_argnums=(2,)) if self.remat
+              else Hourglass)
+
         predictions = []
         for i in range(self.num_stack):
-            hg = Hourglass(num_layer=4, in_ch=self.in_ch,
-                           increase_ch=self.increase_ch,
-                           activation=self.activation, pool=self.pool,
-                           **kw)(x, train)
+            hg = HG(num_layer=4, in_ch=self.in_ch,
+                    increase_ch=self.increase_ch,
+                    activation=self.activation, pool=self.pool,
+                    name=f"Hourglass_{i}", **kw)(x, train)
             feature = Neck(self.in_ch, self.neck_activation, self.neck_pool,
                            **kw)(hg, train)
             prediction = Head(self.out_ch, dtype=self.dtype)(feature)
@@ -322,4 +332,5 @@ def build_model(args_or_cfg, dtype: Optional[Dtype] = None,
         neck_pool=c.neck_pool,
         dtype=dtype,
         bn_axis_name=bn_axis_name,
+        remat=getattr(c, "remat", False),
     )

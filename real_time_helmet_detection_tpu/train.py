@@ -599,7 +599,10 @@ def train(cfg: Config) -> TrainState:
                             profile_this_epoch=(cfg.profile
                                                 and epoch == start_epoch),
                             epoch_base_step=epoch * steps_per_epoch)
-        if is_chief:
+        # every N epochs + always the final one (a full-state save costs
+        # a device_get of params+optimizer — seconds over a remote tunnel)
+        if is_chief and ((epoch + 1) % max(1, cfg.ckpt_interval) == 0
+                         or epoch == cfg.end_epoch - 1):
             path = save_checkpoint(cfg.save_path, epoch, state, loss_log)
             print("%s: epoch %d checkpoint -> %s" % (timestamp(), epoch, path),
                   flush=True)

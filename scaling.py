@@ -26,7 +26,6 @@ import json
 import os
 import subprocess
 import sys
-import time
 
 
 def child(n: int, per_chip_batch: int, imsize: int, iters: int) -> None:

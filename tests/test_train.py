@@ -352,3 +352,22 @@ def test_scanned_train_fn_matches_sequential_steps():
     # one fused scan program vs three separate programs: XLA reassociates
     # float reductions differently, so equality is semantic, not bitwise
     assert float(last_total) == pytest.approx(seq_losses[-1], rel=1e-3)
+
+
+def test_ckpt_interval(tmp_path):
+    """--ckpt-interval N saves every Nth epoch plus the final one."""
+    from real_time_helmet_detection_tpu.data import make_synthetic_voc
+    from real_time_helmet_detection_tpu.train import train
+
+    root = str(tmp_path / "voc")
+    make_synthetic_voc(root, num_train=4, num_test=2, imsize=(64, 64), seed=0)
+    save = str(tmp_path / "w")
+    os.makedirs(os.path.join(save, "training_log"), exist_ok=True)
+    cfg = tiny_cfg(train_flag=True, data=root, save_path=save, batch_size=2,
+                   end_epoch=5, ckpt_interval=2, num_workers=1,
+                   multiscale_flag=True, multiscale=[64, 128, 64],
+                   print_interval=100)
+    train(cfg)
+    ckpts = sorted(d for d in os.listdir(save)
+                   if d.startswith("check_point_"))
+    assert ckpts == ["check_point_2", "check_point_4", "check_point_5"]
