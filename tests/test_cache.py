@@ -136,3 +136,16 @@ def test_train_driver_cache_device_end_to_end(fixture_root, tmp_path):
                    cache_device=True, device_augment=False)
     with pytest.raises(ValueError, match="cache-device requires"):
         train(bad)
+
+
+def test_cache_drop_last_false_pads_by_wrapping(fixture_root):
+    """drop_last=False must yield full fixed-shape index chunks (the jitted
+    cached step cannot take a short final batch) by wrapping."""
+    ds = VOCDataset(fixture_root, image_set="trainval")  # 6 images
+    cache = DeviceDatasetCache(ds, TestAugmentor(64), batch_size=4,
+                               drop_last=False, shuffle=False, seed=0)
+    chunks = list(cache)
+    assert len(chunks) == 2
+    assert all(c.shape == (4,) for c in chunks)
+    np.testing.assert_array_equal(np.concatenate(chunks),
+                                  [0, 1, 2, 3, 4, 5, 0, 1])
