@@ -134,6 +134,9 @@ class Config:
     # always saved); the reference saves every epoch (its train.py:76)
     remat: bool = False           # rematerialize hourglass stacks in bwd
     # (trade FLOPs for HBM: fits num-stack=4 @ 768^2 batches)
+    hang_warn_seconds: float = 300.0  # watchdog: warn when no train step
+    # completes for this long (0 disables). Remote-TPU transports can
+    # wedge mid-run; the reference has no failure detection at all.
     save_path: str = "./WEIGHTS/"
     profile: bool = False         # jax.profiler trace of early train steps
 

@@ -433,11 +433,61 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
     return runner
 
 
+class HangWatchdog:
+    """Background failure detector: warns (with thread stacks) when no
+    progress beat arrives for `warn_seconds`.
+
+    The reference has no failure detection (SURVEY.md §5); this exists
+    because remote accelerator transports can wedge mid-run with the
+    process stuck in an uninterruptible wait — the watchdog cannot unstick
+    it, but it turns a silent stall into a diagnosable one (and tells the
+    operator the last good step, so they know which checkpoint to salvage).
+    """
+
+    def __init__(self, warn_seconds: float, where: str = "train"):
+        import threading
+        self.warn_seconds = float(warn_seconds)
+        self.where = where
+        self._beat = time.monotonic()  # immune to wall-clock NTP steps
+        self._label = "start"
+        self._stop = threading.Event()
+        self._warned = False
+        self._thread = None
+        if self.warn_seconds > 0:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def beat(self, label: str) -> None:
+        self._beat = time.monotonic()
+        self._label = label
+        self._warned = False
+
+    def _run(self) -> None:
+        import faulthandler
+        import sys
+        while not self._stop.wait(min(30.0, self.warn_seconds / 4)):
+            stalled = time.monotonic() - self._beat
+            if stalled > self.warn_seconds and not self._warned:
+                self._warned = True
+                print("%s: WATCHDOG: no %s progress for %.0fs (last: %s) — "
+                      "the device transport may be wedged; if this "
+                      "persists, kill and resume from the last checkpoint"
+                      % (timestamp(), self.where, stalled, self._label),
+                      flush=True)
+                try:  # where is the main thread stuck? (needs a real fd —
+                    faulthandler.dump_traceback(file=sys.__stderr__)
+                except Exception:  # absent under captured/redirected stderr
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
 def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
                 state: TrainState, mesh, loss_log: LossLog,
                 is_chief: bool = True, snapshot_fn=None,
                 profile_this_epoch: bool = False,
-                epoch_base_step: int = 0) -> TrainState:
+                epoch_base_step: int = 0, watchdog=None) -> TrainState:
     """One epoch of the hot loop (≡ ref train.py:86-162 `train_step`)."""
     meters = {k: AverageMeter() for k in ("data", "step")}
     loader.set_epoch(epoch)
@@ -472,6 +522,12 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
         pending.append(losses)
         if i % cfg.print_interval == 0:
             flush_losses()
+            # beat at the flush barrier only: dispatch is async, so a
+            # per-dispatch beat would overstate progress (and delay
+            # detection) by up to print_interval queued-but-unexecuted
+            # steps; the flush is where the host truly observes completion
+            if watchdog is not None:
+                watchdog.beat("epoch %d iter %d (flushed)" % (epoch, i))
         meters["step"].update(time.time() - tic - data_t)
 
         if profiling and i >= 7:
@@ -593,17 +649,28 @@ def train(cfg: Config) -> TrainState:
         print("%s: model built, %d params, mesh %s" % (
             timestamp(), nparams, dict(mesh.shape)), flush=True)
 
-    for epoch in range(start_epoch, cfg.end_epoch):
-        state = train_epoch(cfg, epoch, loader, runner, state, mesh,
-                            loss_log, is_chief, snapshot_fn,
-                            profile_this_epoch=(cfg.profile
-                                                and epoch == start_epoch),
-                            epoch_base_step=epoch * steps_per_epoch)
-        # every N epochs + always the final one (a full-state save costs
-        # a device_get of params+optimizer — seconds over a remote tunnel)
-        if is_chief and ((epoch + 1) % max(1, cfg.ckpt_interval) == 0
-                         or epoch == cfg.end_epoch - 1):
-            path = save_checkpoint(cfg.save_path, epoch, state, loss_log)
-            print("%s: epoch %d checkpoint -> %s" % (timestamp(), epoch, path),
-                  flush=True)
+    watchdog = HangWatchdog(cfg.hang_warn_seconds)
+    try:
+        for epoch in range(start_epoch, cfg.end_epoch):
+            state = train_epoch(cfg, epoch, loader, runner, state, mesh,
+                                loss_log, is_chief, snapshot_fn,
+                                profile_this_epoch=(cfg.profile
+                                                    and epoch == start_epoch),
+                                epoch_base_step=epoch * steps_per_epoch,
+                                watchdog=watchdog)
+            # every N epochs + always the final one (a full-state save costs
+            # a device_get of params+optimizer — seconds over a remote
+            # tunnel)
+            if is_chief and ((epoch + 1) % max(1, cfg.ckpt_interval) == 0
+                             or epoch == cfg.end_epoch - 1):
+                # re-arm before the save too: a full-state device_get can
+                # legitimately take minutes on a slow transport and must
+                # not fire a false "kill and resume" warning mid-write
+                watchdog.beat("epoch %d checkpoint start" % epoch)
+                path = save_checkpoint(cfg.save_path, epoch, state, loss_log)
+                print("%s: epoch %d checkpoint -> %s"
+                      % (timestamp(), epoch, path), flush=True)
+                watchdog.beat("epoch %d checkpoint done" % epoch)
+    finally:
+        watchdog.stop()
     return state

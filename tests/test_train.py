@@ -371,3 +371,31 @@ def test_ckpt_interval(tmp_path):
     ckpts = sorted(d for d in os.listdir(save)
                    if d.startswith("check_point_"))
     assert ckpts == ["check_point_2", "check_point_4", "check_point_5"]
+
+
+def test_hang_watchdog_warns_and_recovers(capsys):
+    """The failure detector fires after `warn_seconds` without a beat,
+    includes the last-progress label, and re-arms after a new beat."""
+    import time as _time
+
+    from real_time_helmet_detection_tpu.train import HangWatchdog
+
+    wd = HangWatchdog(0.2)
+    try:
+        wd.beat("epoch 0 iter 7")
+        _time.sleep(0.6)
+        out = capsys.readouterr().out
+        assert "WATCHDOG" in out and "epoch 0 iter 7" in out
+        assert out.count("WATCHDOG") == 1  # warns once per stall
+        wd.beat("epoch 0 iter 8")
+        _time.sleep(0.6)
+        assert "iter 8" in capsys.readouterr().out  # re-armed
+    finally:
+        wd.stop()
+
+
+def test_hang_watchdog_disabled():
+    from real_time_helmet_detection_tpu.train import HangWatchdog
+    wd = HangWatchdog(0.0)
+    assert wd._thread is None
+    wd.stop()
