@@ -75,6 +75,8 @@ class Config:
 
     # evaluation, demo, export
     export_flag: bool = False     # export the fused predict fn and exit
+    export_raw_input: bool = False  # bake normalization into the export:
+    # the artifact takes raw [0,255] pixels (self-contained deployment)
     imsize: Optional[int] = None
     topk: int = 100
     conf_th: float = 0.0

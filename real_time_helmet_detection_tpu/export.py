@@ -35,10 +35,16 @@ from .config import Config
 from .predict import make_predict_fn
 
 
-def build_export_fn(model, variables, cfg: Config):
+def build_export_fn(model, variables, cfg: Config,
+                    normalize: Optional[str] = None):
     """Close the variables over the fused predict fn: images -> Detections
-    as a flat tuple (boxes, classes, scores, valid)."""
-    predict = make_predict_fn(model, cfg)
+    as a flat tuple (boxes, classes, scores, valid).
+
+    `normalize` bakes the input normalization INTO the artifact (see
+    make_predict_fn): the deployment app then feeds raw [0, 255] pixels —
+    a self-contained artifact, unlike the reference's TorchScript trace
+    whose normalization lives in the C++ app (ref PytorchToCpp)."""
+    predict = make_predict_fn(model, cfg, normalize=normalize)
 
     def fn(images: jax.Array):
         d = predict(variables, images)
@@ -61,9 +67,13 @@ def export_predict(cfg: Config, out_dir: Optional[str] = None,
     imsize = cfg.imsize or 512
 
     model, variables = load_eval_state(cfg)
-    fn = build_export_fn(model, variables, cfg)
+    normalize = cfg.pretrained if cfg.export_raw_input else None
+    fn = build_export_fn(model, variables, cfg, normalize=normalize)
 
-    spec = jax.ShapeDtypeStruct((batch_size, imsize, imsize, 3), jnp.float32)
+    # raw-input artifacts take uint8 pixels: 4x less wire traffic per
+    # frame, with the cast + normalization baked into the program
+    in_dtype = jnp.uint8 if cfg.export_raw_input else jnp.float32
+    spec = jax.ShapeDtypeStruct((batch_size, imsize, imsize, 3), in_dtype)
     exported = jax.export.export(jax.jit(fn))(spec)
 
     bin_path = os.path.join(out_dir, "exported_predict.bin")
@@ -87,7 +97,7 @@ def export_predict(cfg: Config, out_dir: Optional[str] = None,
     with open(os.path.join(out_dir, "meta.json"), "w") as f:
         json.dump({
             "input_shape": [batch_size, imsize, imsize, 3],
-            "input_dtype": "float32",
+            "input_dtype": "uint8" if cfg.export_raw_input else "float32",
             "outputs": ["boxes[B,N,4]", "classes[B,N]", "scores[B,N]",
                         "valid[B,N]"],
             "num_boxes": cfg.num_stack * cfg.topk,
@@ -97,6 +107,9 @@ def export_predict(cfg: Config, out_dir: Optional[str] = None,
             "nms": cfg.nms,
             "nms_th": cfg.nms_th,
             "pretrained": cfg.pretrained,
+            # raw_input: artifact expects [0, 255] pixels (normalization
+            # baked in); else pre-normalized floats
+            "raw_input": bool(cfg.export_raw_input),
         }, f, indent=2)
     return bin_path, mlir_path
 

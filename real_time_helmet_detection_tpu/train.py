@@ -452,6 +452,7 @@ class HangWatchdog:
         self._label = "start"
         self._stop = threading.Event()
         self._warned = False
+        self._paused = False
         self._thread = None
         if self.warn_seconds > 0:
             self._thread = threading.Thread(target=self._run, daemon=True)
@@ -462,12 +463,24 @@ class HangWatchdog:
         self._label = label
         self._warned = False
 
+    def pause(self, label: str) -> None:
+        """Suspend warnings across a known-slow operation (checkpoint save:
+        a full-state device_get can legitimately take minutes on a slow
+        transport). A point beat only resets the clock; pause holds it."""
+        self._paused = True
+        self._label = label
+
+    def resume(self, label: str) -> None:
+        self._paused = False
+        self.beat(label)
+
     def _run(self) -> None:
         import faulthandler
         import sys
         while not self._stop.wait(min(30.0, self.warn_seconds / 4)):
             stalled = time.monotonic() - self._beat
-            if stalled > self.warn_seconds and not self._warned:
+            if stalled > self.warn_seconds and not self._warned \
+                    and not self._paused:
                 self._warned = True
                 print("%s: WATCHDOG: no %s progress for %.0fs (last: %s) — "
                       "the device transport may be wedged; if this "
@@ -661,16 +674,23 @@ def train(cfg: Config) -> TrainState:
             # every N epochs + always the final one (a full-state save costs
             # a device_get of params+optimizer — seconds over a remote
             # tunnel)
-            if is_chief and ((epoch + 1) % max(1, cfg.ckpt_interval) == 0
-                             or epoch == cfg.end_epoch - 1):
-                # re-arm before the save too: a full-state device_get can
-                # legitimately take minutes on a slow transport and must
-                # not fire a false "kill and resume" warning mid-write
-                watchdog.beat("epoch %d checkpoint start" % epoch)
-                path = save_checkpoint(cfg.save_path, epoch, state, loss_log)
-                print("%s: epoch %d checkpoint -> %s"
-                      % (timestamp(), epoch, path), flush=True)
-                watchdog.beat("epoch %d checkpoint done" % epoch)
+            if (epoch + 1) % max(1, cfg.ckpt_interval) == 0 \
+                    or epoch == cfg.end_epoch - 1:
+                # warnings are suspended across the save on EVERY process:
+                # the chief's full-state device_get can legitimately take
+                # minutes, and non-chief processes spend that time blocked
+                # at the next collective — neither is a hang. (A non-chief
+                # resumes immediately and re-pauses nothing: its block
+                # inside the first post-boundary step cannot be
+                # distinguished from a wedge without cross-host signaling,
+                # so the boundary pause is the best local approximation.)
+                watchdog.pause("epoch %d boundary (checkpoint)" % epoch)
+                if is_chief:
+                    path = save_checkpoint(cfg.save_path, epoch, state,
+                                           loss_log)
+                    print("%s: epoch %d checkpoint -> %s"
+                          % (timestamp(), epoch, path), flush=True)
+                watchdog.resume("epoch %d checkpoint done" % epoch)
     finally:
         watchdog.stop()
     return state

@@ -159,3 +159,32 @@ def test_batchloader_producer_error_propagates(voc_root):
     l = BatchLoader(ds, BoomAug(), batch_size=2, num_workers=1, max_boxes=8)
     with pytest.raises(RuntimeError, match="boom"):
         next(iter(l))
+
+
+def test_loader_iteration_deterministic_under_threads(tmp_path):
+    """The threaded producer/prefetch pipeline must be order- and
+    content-deterministic: two passes with the same (seed, epoch) yield
+    identical batches (the reference delegates this to torch DataLoader;
+    here it is pinned — SURVEY §5 lists race detection as absent there)."""
+    from real_time_helmet_detection_tpu.data import (BatchLoader,
+                                                     TestAugmentor,
+                                                     VOCDataset,
+                                                     make_synthetic_voc)
+
+    root = make_synthetic_voc(str(tmp_path), num_train=10, num_test=2,
+                              imsize=(64, 64), seed=4)
+    ds = VOCDataset(root, image_set="trainval")
+    loader = BatchLoader(ds, TestAugmentor(64), batch_size=3, shuffle=True,
+                         drop_last=False, seed=7, num_workers=4, raw=True)
+    loader.set_epoch(2)
+    a = [(b.image.copy(), b.boxes.copy(), [i["annotation"]["filename"]
+                                           for i in b.infos])
+         for b in loader]
+    b_ = [(b.image.copy(), b.boxes.copy(), [i["annotation"]["filename"]
+                                            for i in b.infos])
+          for b in loader]
+    assert len(a) == len(b_) == 4
+    for (ia, ba, na), (ib, bb, nb) in zip(a, b_):
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_array_equal(ba, bb)
+        assert na == nb

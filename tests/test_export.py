@@ -64,3 +64,36 @@ def test_exported_matches_eager(exported):
     np.testing.assert_allclose(np.asarray(scores), np.asarray(r_scores),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_array_equal(np.asarray(valid), np.asarray(r_valid))
+
+
+def test_export_raw_input_bakes_normalization(tmp_path):
+    """--export-raw-input artifacts take [0,255] pixels and must agree
+    with the normalized-input artifact fed host-normalized pixels."""
+    import numpy as np
+
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.export import (export_predict,
+                                                       load_exported)
+    from real_time_helmet_detection_tpu.utils import normalize_image
+
+    base = dict(num_stack=1, hourglass_inch=16, num_cls=2, topk=10,
+                conf_th=0.0, nms_th=0.5, imsize=64, train_flag=False,
+                random_seed=1)
+    raw_dir, norm_dir = str(tmp_path / "raw"), str(tmp_path / "norm")
+    export_predict(Config(export_raw_input=True, save_path=raw_dir, **base),
+                   out_dir=raw_dir)
+    export_predict(Config(save_path=norm_dir, **base), out_dir=norm_dir)
+
+    import json
+    with open(raw_dir + "/meta.json") as f:
+        assert json.load(f)["raw_input"] is True
+
+    rng = np.random.default_rng(0)
+    raw = rng.integers(0, 255, (1, 64, 64, 3), dtype=np.uint8)
+    normed = np.stack([normalize_image(im, "imagenet") for im in raw])
+    f_raw = load_exported(raw_dir + "/exported_predict.bin")
+    f_norm = load_exported(norm_dir + "/exported_predict.bin")
+    b1, c1, s1, v1 = f_raw.call(jnp.asarray(raw))  # uint8 in
+    b2, c2, s2, v2 = f_norm.call(jnp.asarray(normed))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(b1), np.asarray(b2), atol=1e-3)

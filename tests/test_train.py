@@ -399,3 +399,20 @@ def test_hang_watchdog_disabled():
     wd = HangWatchdog(0.0)
     assert wd._thread is None
     wd.stop()
+
+
+def test_hang_watchdog_pause_suppresses(capsys):
+    import time as _time
+
+    from real_time_helmet_detection_tpu.train import HangWatchdog
+
+    wd = HangWatchdog(0.2)
+    try:
+        wd.pause("checkpoint")
+        _time.sleep(0.6)
+        assert "WATCHDOG" not in capsys.readouterr().out
+        wd.resume("done")
+        _time.sleep(0.6)
+        assert "WATCHDOG" in capsys.readouterr().out  # detection re-armed
+    finally:
+        wd.stop()
