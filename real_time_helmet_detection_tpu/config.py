@@ -134,6 +134,10 @@ class Config:
     print_interval: int = 100
     ckpt_interval: int = 1        # checkpoint every N epochs (final epoch
     # always saved); the reference saves every epoch (its train.py:76)
+    async_ckpt: bool = False      # overlap checkpoint D2H+write with the
+    # next epoch's training (orbax AsyncCheckpointer). Single-host only;
+    # transiently holds a second on-device copy of the train state, so
+    # avoid when already at the HBM limit (e.g. --remat-sized configs)
     remat: bool = False           # rematerialize hourglass stacks in bwd
     # (trade FLOPs for HBM: fits num-stack=4 @ 768^2 batches)
     hang_warn_seconds: float = 300.0  # watchdog: warn when no train step

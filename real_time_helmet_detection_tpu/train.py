@@ -260,24 +260,91 @@ def make_cached_device_train_step(model, tx, cfg: Config, mesh, target: int,
     return run
 
 
+def _checkpoint_path(save_path: str, epoch: int) -> str:
+    """The on-disk naming contract (≡ ref `check_point_{epoch+1}.pth`)."""
+    return os.path.abspath(os.path.join(save_path,
+                                        f"check_point_{epoch + 1}"))
+
+
+def _write_loss_log(path: str, log_state: dict) -> None:
+    with open(os.path.join(path, "loss_log.json"), "w") as f:
+        json.dump(log_state, f)
+
+
+def _checkpoint_item(epoch: int, state: TrainState) -> dict:
+    # plain nested dicts: restorable without reconstructing TrainState /
+    # optimizer pytree types first (see _restore_raw)
+    return {"state": {"step": state.step, "params": state.params,
+                      "batch_stats": state.batch_stats,
+                      "opt_state": state.opt_state},
+            "epoch": epoch}
+
+
 def save_checkpoint(save_path: str, epoch: int, state: TrainState,
                     loss_log: LossLog) -> str:
     """Per-epoch full-state checkpoint (≡ ref train.py:76-82
     `check_point_{epoch+1}.pth`)."""
     import orbax.checkpoint as ocp
-    path = os.path.abspath(os.path.join(save_path, f"check_point_{epoch + 1}"))
+    path = _checkpoint_path(save_path, epoch)
     ckpt = ocp.StandardCheckpointer()
-    # plain nested dicts: restorable without reconstructing TrainState /
-    # optimizer pytree types first (see _restore_raw)
-    item = {"state": {"step": state.step, "params": state.params,
-                      "batch_stats": state.batch_stats,
-                      "opt_state": state.opt_state},
-            "epoch": epoch}
-    ckpt.save(path, jax.device_get(item), force=True)
+    ckpt.save(path, jax.device_get(_checkpoint_item(epoch, state)),
+              force=True)
     ckpt.wait_until_finished()
-    with open(os.path.join(path, "loss_log.json"), "w") as f:
-        json.dump(loss_log.state_dict(), f)
+    _write_loss_log(path, loss_log.state_dict())
     return path
+
+
+class CheckpointWriter:
+    """Checkpoint writer with an optional async mode (`--async-ckpt`).
+
+    Sync mode = `save_checkpoint` (blocking D2H + write each epoch, the
+    reference's behavior). Async mode hands orbax the DEVICE arrays and
+    returns immediately — the device->host fetch and file write overlap
+    the next epoch's training (a full-state fetch is seconds-to-minutes on
+    slow transports); the previous save is awaited before starting the
+    next, and `finalize()` awaits the last one at the end of training.
+    """
+
+    def __init__(self, async_save: bool = False):
+        import orbax.checkpoint as ocp
+        self.async_save = async_save
+        self._ckpt = (ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+                      if async_save else None)
+        # orbax writes the checkpoint dir atomically (tmp + rename), so the
+        # loss-log sidecar can only be placed inside once the save has
+        # finished — deferred until the next wait point
+        self._pending_sidecars: list = []
+
+    def _write_sidecars(self) -> None:
+        for path, log_state in self._pending_sidecars:
+            _write_loss_log(path, log_state)
+        self._pending_sidecars.clear()
+
+    def save(self, save_path: str, epoch: int, state: TrainState,
+             loss_log: LossLog) -> str:
+        if not self.async_save:
+            return save_checkpoint(save_path, epoch, state, loss_log)
+        import orbax.checkpoint as ocp
+        path = _checkpoint_path(save_path, epoch)
+        self._ckpt.wait_until_finished()  # at most one save in flight
+        self._write_sidecars()
+        # Device-side snapshot: the training loop DONATES the state into
+        # the next step, which would invalidate the buffers orbax is still
+        # streaming to host. ONE jitted program (not a per-leaf eager map:
+        # each eager op is its own dispatch — ~70 ms each on a remote
+        # tunnel, and the state has hundreds of leaves). Note the snapshot
+        # transiently doubles the state's HBM footprint until the D2H
+        # completes (see config.py --async-ckpt comment).
+        item = jax.jit(lambda t: jax.tree.map(jnp.copy, t))(
+            _checkpoint_item(epoch, state))
+        self._ckpt.save(path, args=ocp.args.StandardSave(item), force=True)
+        self._pending_sidecars.append((path, loss_log.state_dict()))
+        return path
+
+    def finalize(self) -> None:
+        if self._ckpt is not None:
+            self._ckpt.wait_until_finished()
+            self._write_sidecars()
 
 
 def _restore_raw(path: str) -> dict:
@@ -295,6 +362,11 @@ def _read_loss_log(path: str) -> LossLog:
     if os.path.exists(log_path):
         with open(log_path) as f:
             return LossLog(json.load(f))
+    # possible with --async-ckpt: a kill between the background save
+    # completing and the next sidecar flush leaves a valid checkpoint with
+    # no loss history — resume proceeds, history restarts
+    print("%s: warning: %s has no loss_log.json; resuming with an empty "
+          "loss history" % (timestamp(), path), flush=True)
     return LossLog()
 
 
@@ -662,7 +734,12 @@ def train(cfg: Config) -> TrainState:
         print("%s: model built, %d params, mesh %s" % (
             timestamp(), nparams, dict(mesh.shape)), flush=True)
 
+    if cfg.async_ckpt and jax.process_count() > 1:
+        # the chief-only device-side snapshot + orbax save would touch
+        # non-addressable devices / hang the multi-host save barrier
+        raise ValueError("--async-ckpt is single-host only")
     watchdog = HangWatchdog(cfg.hang_warn_seconds)
+    writer = CheckpointWriter(async_save=cfg.async_ckpt)
     try:
         for epoch in range(start_epoch, cfg.end_epoch):
             state = train_epoch(cfg, epoch, loader, runner, state, mesh,
@@ -686,11 +763,12 @@ def train(cfg: Config) -> TrainState:
                 # so the boundary pause is the best local approximation.)
                 watchdog.pause("epoch %d boundary (checkpoint)" % epoch)
                 if is_chief:
-                    path = save_checkpoint(cfg.save_path, epoch, state,
-                                           loss_log)
+                    path = writer.save(cfg.save_path, epoch, state, loss_log)
                     print("%s: epoch %d checkpoint -> %s"
                           % (timestamp(), epoch, path), flush=True)
                 watchdog.resume("epoch %d checkpoint done" % epoch)
     finally:
+        watchdog.pause("finalizing checkpoints")
+        writer.finalize()
         watchdog.stop()
     return state

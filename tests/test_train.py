@@ -416,3 +416,56 @@ def test_hang_watchdog_pause_suppresses(capsys):
         assert "WATCHDOG" in capsys.readouterr().out  # detection re-armed
     finally:
         wd.stop()
+
+
+def test_async_checkpoint_roundtrip(tmp_path):
+    """--async-ckpt saves must be restorable and equal to the saved state,
+    including the deferred loss-log sidecar."""
+    from real_time_helmet_detection_tpu.train import CheckpointWriter
+
+    cfg = tiny_cfg()
+    model, tx, state = make_state(cfg)
+    mesh = make_mesh(1)
+    step = make_train_step(model, tx, cfg, mesh)
+    batch = shard_batch(mesh, synthetic_batch(), spatial_dims=[1] * 5)
+    state, losses = step(state, *batch)
+
+    log = LossLog()
+    log.append({k: float(v) for k, v in jax.device_get(losses).items()})
+    writer = CheckpointWriter(async_save=True)
+    expected_p0 = jax.device_get(jax.tree.leaves(state.params)[0]).copy()
+    path = writer.save(str(tmp_path), 0, state, log)
+    # mutate state AFTER handing it to the async writer (simulates the
+    # next donated train step invalidating the buffers)
+    state2, _ = step(state, *batch)
+    writer.finalize()
+    assert os.path.exists(os.path.join(path, "loss_log.json"))
+
+    _, _, fresh = make_state(cfg)
+    restored, epoch, rlog = load_checkpoint(path, fresh)
+    assert epoch == 0
+    assert rlog.state_dict() == log.state_dict()
+    # restored equals the state at save time, not the mutated one
+    np.testing.assert_allclose(
+        jax.device_get(jax.tree.leaves(restored.params)[0]), expected_p0)
+    assert not np.allclose(
+        expected_p0, jax.device_get(jax.tree.leaves(state2.params)[0]))
+
+
+def test_train_driver_async_ckpt(tmp_path):
+    from real_time_helmet_detection_tpu.data import make_synthetic_voc
+    from real_time_helmet_detection_tpu.train import train
+
+    root = str(tmp_path / "voc")
+    make_synthetic_voc(root, num_train=4, num_test=2, imsize=(64, 64), seed=0)
+    save = str(tmp_path / "w")
+    os.makedirs(os.path.join(save, "training_log"), exist_ok=True)
+    cfg = tiny_cfg(train_flag=True, data=root, save_path=save, batch_size=2,
+                   end_epoch=2, async_ckpt=True, num_workers=1,
+                   multiscale_flag=True, multiscale=[64, 128, 64],
+                   print_interval=100)
+    train(cfg)
+    for e in (1, 2):
+        d = os.path.join(save, "check_point_%d" % e)
+        assert os.path.isdir(d)
+        assert os.path.exists(os.path.join(d, "loss_log.json"))
