@@ -157,3 +157,37 @@ def test_encode_batch_stacks():
                                     (256, 256))
     assert h.shape == (2, 64, 64, 2)
     assert m[1].sum() == 0
+
+
+def test_encode_zero_area_box_no_nan():
+    """A degenerate (zero-area) box must not produce NaNs or a zero sigma
+    blowup — the radius/sigma clamp handles it."""
+    boxes = np.array([[10.0, 10.0, 10.0, 10.0]], np.float32)
+    labels = np.array([0], np.int32)
+    heat, off, wh, mask = encode_boxes(boxes, labels, (64, 64), 4, 2, False)
+    assert np.isfinite(heat).all() and np.isfinite(off).all()
+    assert np.isfinite(wh).all()
+    assert heat.max() <= 1.0
+
+
+def test_encode_box_on_image_edge_clips_indices():
+    """Centers at/over the image border must clip into the map, not wrap
+    or crash (ref transform.py center-index int division)."""
+    boxes = np.array([[56.0, 56.0, 64.0, 64.0],   # touches bottom-right
+                      [0.0, 0.0, 4.0, 4.0]], np.float32)
+    labels = np.array([0, 1], np.int32)
+    heat, off, wh, mask = encode_boxes(boxes, labels, (64, 64), 4, 2, False)
+    assert mask.sum() == 2
+    assert np.isfinite(heat).all()
+
+
+def test_decode_conf_above_all_scores_fixed_shape():
+    """conf_th above every score: fixed shapes with valid all-False (the
+    eval path then writes no detections) — never a shape change."""
+    heat = jnp.zeros((16, 16, 2)) + 0.3
+    off = jnp.zeros((16, 16, 2))
+    wh = jnp.ones((16, 16, 2))
+    dets = decode_heatmap(heat, off, wh, scale_factor=4, topk=10,
+                          conf_th=0.99, normalized=False)
+    assert dets.boxes.shape == (10, 4)
+    assert not bool(np.asarray(dets.valid).any())

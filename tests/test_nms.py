@@ -88,3 +88,16 @@ def test_soft_nms_kills_duplicates():
     keep, new_scores = soft_nms_mask(boxes, scores, jnp.ones(2, bool),
                                      sigma=0.5, score_th=0.2)
     assert np.asarray(keep).tolist() == [True, False]
+
+
+def test_nms_three_hundred_near_duplicates_keep_one():
+    """The classic deployment probe: hundreds of near-identical boxes in,
+    one survivor out."""
+    rng = np.random.default_rng(0)
+    base = np.array([50.0, 50.0, 150.0, 150.0], np.float32)
+    boxes = base + rng.uniform(-1.5, 1.5, (300, 4)).astype(np.float32)
+    scores = rng.uniform(0.5, 1.0, 300).astype(np.float32)
+    keep = np.asarray(nms_mask(jnp.asarray(boxes), jnp.asarray(scores),
+                               jnp.ones(300, bool), 0.5))
+    assert keep.sum() == 1
+    assert keep[np.argmax(scores)]
