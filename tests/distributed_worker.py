@@ -15,8 +15,12 @@ import sys
 
 rank, world, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
                              int(sys.argv[3]), sys.argv[4])
+# devices contributed by THIS process (multi-device-per-host = the real
+# pod topology: a v5e host drives 4-8 chips)
+ndev_local = int(sys.argv[5]) if len(sys.argv) > 5 else 1
 
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d"
+                           % ndev_local)
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
@@ -34,19 +38,21 @@ from real_time_helmet_detection_tpu.train import (create_train_state,  # noqa: E
                                                   make_train_step)
 
 IMSIZE = 64
-GLOBAL_BATCH = 4
+GLOBAL_BATCH = 4  # per data-axis device pair; scaled by ndev_local below
 
 
 def main() -> None:
+    global GLOBAL_BATCH
+    GLOBAL_BATCH = GLOBAL_BATCH * ndev_local
     cfg = Config(num_stack=1, hourglass_inch=16, num_cls=2,
                  batch_size=GLOBAL_BATCH, lr=1e-3, world_size=world,
                  rank=rank, dist_url="tcp://127.0.0.1:%d" % port)
     init_distributed(cfg)
     assert jax.process_count() == world, jax.process_count()
-    assert len(jax.devices()) == world
-    assert len(jax.local_devices()) == 1
+    assert len(jax.devices()) == world * ndev_local
+    assert len(jax.local_devices()) == ndev_local
 
-    mesh = make_mesh(world)
+    mesh = make_mesh(world * ndev_local)
     model = build_model(cfg)
     tx = build_optimizer(cfg, 10)
     state = create_train_state(model, cfg, jax.random.key(0), IMSIZE, tx)

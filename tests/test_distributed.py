@@ -28,12 +28,17 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_train_step_matches_single(tmp_path):
+@pytest.mark.parametrize("ndev_local", [1, 2])
+def test_two_process_train_step_matches_single(tmp_path, ndev_local):
+    """2 processes x ndev_local devices: ndev_local=2 exercises the real
+    pod topology (multiple local devices per host joining one global mesh,
+    global-array assembly spanning hosts AND local devices)."""
     port = _free_port()
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(rank), "2", str(port), str(tmp_path)],
+            [sys.executable, WORKER, str(rank), "2", str(port), str(tmp_path),
+             str(ndev_local)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env)
         for rank in (0, 1)
@@ -68,7 +73,7 @@ def test_two_process_train_step_matches_single(tmp_path):
                                                       make_train_step)
     import jax
 
-    IMSIZE, B = 64, 4
+    IMSIZE, B = 64, 4 * ndev_local
     cfg = Config(num_stack=1, hourglass_inch=16, num_cls=2, batch_size=B,
                  lr=1e-3)
     model = build_model(cfg)
