@@ -5,7 +5,10 @@ TorchScript C++ app (/root/reference/README.md:76). This bench measures, on
 one chip, steady-state:
 
 * `inference_fps_512` (primary) — the fused predict path (network forward
-  -> sigmoid -> decode -> NMS) as ONE jitted XLA program at batch 8;
+  -> sigmoid -> decode -> NMS) as ONE jitted XLA program at batch 16.
+  Batch choice is from the r02 sweep (scripts/tpu_sweep.py): batch 8 sits
+  in a tiling dip (~1000 img/s), 16 gives ~1214, and 32 is the true peak
+  (~1271) at double the per-batch latency — 16 is the near-peak default;
 * `latency_ms_b1` — batch-1 device latency (the reference's "real-time"
   framing);
 * `train_img_per_sec_chip` — train-step throughput at the flagship config
@@ -156,7 +159,7 @@ def main() -> None:
 
     # CPU fallback: scaled-down shapes so the bench finishes; clearly labeled.
     imsize = 512 if on_tpu else 128
-    batch = 8 if on_tpu else 2
+    batch = 16 if on_tpu else 2
     train_batch = 16 if on_tpu else 2
     # scan lengths: long enough that the ~70 ms dispatch overhead is noise
     n_inf = 512 if on_tpu else 4

@@ -1,0 +1,252 @@
+"""Single-chip TPU sweep: batch-size scaling, num_stack=2, remat analysis.
+
+Completes the round-2 experiment matrix that the tunnel outage interrupted
+(artifacts/r02/README.md §7): how throughput and MFU scale with batch size
+for inference and training, what a deeper model (num_stack=2 — the
+reference's self-test config, ref hourglass.py:241) costs, and what
+`--remat` buys in HBM versus FLOPs at the flagship config.
+
+Methodology is bench.py's (scan N iters inside ONE program, subtract
+dispatch overhead — see bench.py's module docstring for why); this script
+imports those helpers rather than re-deriving them. Each config is
+independently guarded: a failed compile (e.g. OOM at large batch) records
+the error string instead of killing the sweep.
+
+The dev tunnel can wedge mid-run (CLAUDE.md), so results MERGE into
+artifacts/r02/sweep.json after every single config — a killed run loses at
+most the in-flight config — and `--only <section>[,<section>]` reruns just
+the missing sections (inference, train, stack2, remat).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import (DEFAULT_PEAK, PEAK_BF16, acquire_backend, flops_of, log,
+                   measure_dispatch_overhead, timed_fetch)
+
+
+def memory_analysis_of(compiled):
+    """Peak/argument/output HBM bytes from XLA, when the plugin supports it."""
+    try:
+        mem = compiled.memory_analysis()
+        if mem is None:
+            return None
+        return {
+            "temp_mb": round(mem.temp_size_in_bytes / 2**20, 1),
+            "argument_mb": round(mem.argument_size_in_bytes / 2**20, 1),
+            "output_mb": round(mem.output_size_in_bytes / 2**20, 1),
+            "peak_mb": round(
+                (mem.temp_size_in_bytes + mem.argument_size_in_bytes
+                 + mem.output_size_in_bytes) / 2**20, 1),
+        }
+    except Exception as e:  # noqa: BLE001 — plugin-dependent API
+        log("memory_analysis unavailable: %r" % e)
+        return None
+
+
+OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "artifacts", "r02", "sweep.json")
+
+
+def main() -> None:
+    only = None
+    for i, a in enumerate(sys.argv):
+        if a == "--only" and i + 1 < len(sys.argv):
+            only = set(sys.argv[i + 1].split(","))
+
+    jax, devs = acquire_backend()
+    import jax.numpy as jnp
+    from jax import lax
+
+    platform = devs[0].platform
+    device_kind = getattr(devs[0], "device_kind", "unknown")
+    on_tpu = platform == "tpu"
+    peak = DEFAULT_PEAK
+    for key, val in PEAK_BF16.items():
+        if key in device_kind.lower():
+            peak = val
+            break
+    log("backend: %s (%s)" % (device_kind, platform))
+
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.data import synthetic_target_batch
+    from real_time_helmet_detection_tpu.models import build_model
+    from real_time_helmet_detection_tpu.optim import build_optimizer
+    from real_time_helmet_detection_tpu.predict import make_predict_fn
+    from real_time_helmet_detection_tpu.train import (
+        create_train_state, init_variables, make_scanned_train_fn,
+        make_train_step_body)
+
+    imsize = 512 if on_tpu else 64
+    overhead = measure_dispatch_overhead()
+    log("dispatch overhead: %.1f ms" % (overhead * 1e3))
+    rng = np.random.default_rng(0)
+    results = {
+        "platform": platform, "device_kind": device_kind, "imsize": imsize,
+        "dispatch_ms": round(overhead * 1e3, 3),
+        "inference_batch_sweep": [], "train_batch_sweep": [],
+        "num_stack2": {}, "remat": [],
+    }
+    if only and os.path.exists(OUT_PATH):
+        with open(OUT_PATH) as f:
+            prior = json.load(f)
+        if prior.get("platform") == platform:
+            # keep prior results only for sections NOT being rerun — a rerun
+            # section starts empty, else its records would duplicate
+            section_keys = {"inference": "inference_batch_sweep",
+                            "train": "train_batch_sweep",
+                            "stack2": "num_stack2", "remat": "remat"}
+            for sec, k in section_keys.items():
+                if sec not in only:
+                    results[k] = prior.get(k, results[k])
+
+    def flush():
+        with open(OUT_PATH, "w") as f:
+            json.dump(results, f, indent=1)
+
+    def want(section):
+        return only is None or section in only
+
+    def predict_chain(predict, n):
+        def prog(variables, images):
+            def body(imgs, _):
+                det = predict(variables, imgs)
+                eps = (jnp.tanh(jnp.sum(det.scores)) * 1e-12).astype(
+                    imgs.dtype)
+                return imgs + eps, ()
+            final, _ = lax.scan(body, images, None, length=n)
+            return jnp.sum(final[0, 0, 0])
+        return jax.jit(prog)
+
+    def bench_inference(num_stack, batch, n):
+        cfg = Config(num_stack=num_stack, hourglass_inch=128, num_cls=2,
+                     topk=100, conf_th=0.0, nms_th=0.5, imsize=imsize)
+        model = build_model(cfg, dtype=jnp.bfloat16)
+        params, batch_stats = init_variables(model, jax.random.key(0), imsize)
+        variables = {"params": params, "batch_stats": batch_stats}
+        predict = make_predict_fn(model, cfg)
+        images = jnp.asarray(rng.standard_normal(
+            (batch, imsize, imsize, 3)).astype(np.float32))
+        t0 = time.perf_counter()
+        compiled = predict_chain(predict, n).lower(
+            variables, images).compile()
+        compile_s = time.perf_counter() - t0
+        fl = flops_of(compiled)
+        np.asarray(compiled(variables, images))  # warmup
+        dt = timed_fetch(compiled, (variables, images), overhead)
+        rec = {"batch": batch, "img_per_sec": round(batch * n / dt, 1),
+               "ms_per_batch": round(dt / n * 1e3, 3),
+               "compile_s": round(compile_s, 1)}
+        if fl:
+            rec["mfu_fwd"] = round(fl * n / dt / peak, 4)
+        return rec
+
+    def bench_train(num_stack, batch, n, remat):
+        cfg = Config(num_stack=num_stack, hourglass_inch=128, num_cls=2,
+                     batch_size=batch, amp=True, imsize=imsize, remat=remat)
+        model = build_model(cfg, dtype=jnp.bfloat16)
+        tx = build_optimizer(cfg, 100)
+        state = create_train_state(model, cfg, jax.random.key(0), imsize, tx)
+        body = make_train_step_body(model, tx, cfg)
+        arrs = tuple(jnp.asarray(a) for a in synthetic_target_batch(
+            batch, imsize, pos_rate=0.01))
+        train_n = make_scanned_train_fn(body, n)
+        t0 = time.perf_counter()
+        compiled = jax.jit(train_n, donate_argnums=(0,)).lower(
+            state, *arrs).compile()
+        compile_s = time.perf_counter() - t0
+        fl = flops_of(compiled)
+        mem = memory_analysis_of(compiled)
+        np.asarray(compiled(state, *arrs)[1])  # warmup (donates state)
+        state = create_train_state(model, cfg, jax.random.key(0), imsize, tx)
+        dt = timed_fetch(compiled, (state, *arrs), overhead, repeats=1)
+        rec = {"batch": batch, "remat": remat,
+               "img_per_sec_chip": round(batch * n / dt, 1),
+               "step_ms": round(dt / n * 1e3, 3),
+               "compile_s": round(compile_s, 1)}
+        if fl:
+            rec["mfu_train"] = round(fl * n / dt / peak, 4)
+        if mem:
+            rec["memory"] = mem
+        return rec
+
+    # --- 1. inference batch sweep ----------------------------------------
+    if want("inference"):
+        for batch in ([1, 2, 4, 8, 16, 32] if on_tpu else [1, 2]):
+            n = max(32, min(512, 4096 // batch)) if on_tpu else 2
+            try:
+                rec = bench_inference(1, batch, n)
+                results["inference_batch_sweep"].append(rec)
+                log("infer b=%d: %s" % (batch, rec))
+            except Exception as e:  # noqa: BLE001
+                results["inference_batch_sweep"].append(
+                    {"batch": batch, "error": str(e).splitlines()[-1][:200]})
+                log("infer b=%d FAILED: %r" % (batch, e))
+            flush()
+
+    # --- 2. train batch sweep --------------------------------------------
+    if want("train"):
+        # 16 (the flagship config, known-good compile) first: if IT hangs,
+        # the tunnel is wedged; if only another batch hangs, that config is
+        # the problem.
+        for batch in ([16, 8, 32, 64] if on_tpu else [2]):
+            n = max(8, min(64, 1024 // batch)) if on_tpu else 2
+            try:
+                rec = bench_train(1, batch, n, remat=False)
+                results["train_batch_sweep"].append(rec)
+                log("train b=%d: %s" % (batch, rec))
+            except Exception as e:  # noqa: BLE001
+                results["train_batch_sweep"].append(
+                    {"batch": batch, "error": str(e).splitlines()[-1][:200]})
+                log("train b=%d FAILED: %r" % (batch, e))
+            flush()
+
+    # --- 3. num_stack=2 datapoint (ref hourglass.py:241 self-test config) -
+    if want("stack2"):
+        try:
+            results["num_stack2"]["inference"] = bench_inference(
+                2, 8 if on_tpu else 1, 256 if on_tpu else 2)
+            log("stack2 infer: %s" % results["num_stack2"]["inference"])
+        except Exception as e:  # noqa: BLE001
+            results["num_stack2"]["inference"] = {
+                "error": str(e).splitlines()[-1][:200]}
+        flush()
+        try:
+            results["num_stack2"]["train"] = bench_train(
+                2, 16 if on_tpu else 2, 32 if on_tpu else 2, remat=False)
+            log("stack2 train: %s" % results["num_stack2"]["train"])
+        except Exception as e:  # noqa: BLE001
+            results["num_stack2"]["train"] = {
+                "error": str(e).splitlines()[-1][:200]}
+        flush()
+
+    # --- 4. remat on/off at flagship + large batch ------------------------
+    if want("remat"):
+        for batch, remat in ([(16, True), (64, True)] if on_tpu
+                             else [(2, True)]):
+            n = max(8, min(64, 1024 // batch)) if on_tpu else 2
+            try:
+                rec = bench_train(1, batch, n, remat=remat)
+                results["remat"].append(rec)
+                log("remat b=%d: %s" % (batch, rec))
+            except Exception as e:  # noqa: BLE001
+                results["remat"].append(
+                    {"batch": batch, "remat": remat,
+                     "error": str(e).splitlines()[-1][:200]})
+                log("remat b=%d FAILED: %r" % (batch, e))
+            flush()
+
+    flush()
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,12 +1,13 @@
 """Worker for the 2-process jax.distributed CPU test (test_distributed.py).
 
-Each process contributes ONE virtual CPU device to a 2-device global mesh,
-runs the multi-host branch of `shard_batch` (make_array_from_process_local_data,
-parallel/mesh.py) and one sharded train step — the exact code path a real
-multi-host TPU run uses over DCN (≡ reference mp.spawn + NCCL worker,
-/root/reference/train.py:23-45).
+Each process contributes <ndev_local> virtual CPU devices (default 1) to a
+world*ndev_local-device global mesh — ndev_local>1 models the real pod
+topology where one host drives several chips — runs the multi-host branch
+of `shard_batch` (make_array_from_process_local_data, parallel/mesh.py) and
+one sharded train step: the exact code path a real multi-host TPU run uses
+over DCN (≡ reference mp.spawn + NCCL worker, /root/reference/train.py:23-45).
 
-Usage: python distributed_worker.py <rank> <world> <port> <outdir>
+Usage: python distributed_worker.py <rank> <world> <port> <outdir> [ndev_local]
 """
 
 import json
@@ -38,14 +39,13 @@ from real_time_helmet_detection_tpu.train import (create_train_state,  # noqa: E
                                                   make_train_step)
 
 IMSIZE = 64
-GLOBAL_BATCH = 4  # per data-axis device pair; scaled by ndev_local below
+BATCH_PER_DEVICE_PAIR = 4
 
 
 def main() -> None:
-    global GLOBAL_BATCH
-    GLOBAL_BATCH = GLOBAL_BATCH * ndev_local
+    global_batch = BATCH_PER_DEVICE_PAIR * ndev_local
     cfg = Config(num_stack=1, hourglass_inch=16, num_cls=2,
-                 batch_size=GLOBAL_BATCH, lr=1e-3, world_size=world,
+                 batch_size=global_batch, lr=1e-3, world_size=world,
                  rank=rank, dist_url="tcp://127.0.0.1:%d" % port)
     init_distributed(cfg)
     assert jax.process_count() == world, jax.process_count()
@@ -61,8 +61,8 @@ def main() -> None:
     # deterministic GLOBAL batch; this process feeds its contiguous row block
     # (mesh device order = process order on the data axis)
     from real_time_helmet_detection_tpu.data import synthetic_target_batch
-    g = synthetic_target_batch(GLOBAL_BATCH, IMSIZE)
-    per = GLOBAL_BATCH // world
+    g = synthetic_target_batch(global_batch, IMSIZE)
+    per = global_batch // world
     local = tuple(a[rank * per:(rank + 1) * per] for a in g)
     arrays = shard_batch(mesh, local, spatial_dims=[1] * 5)
 
