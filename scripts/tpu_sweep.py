@@ -55,6 +55,26 @@ def memory_analysis_of(compiled):
 OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "artifacts", "r02", "sweep.json")
 
+# section name (CLI --only vocabulary) -> results key
+SECTION_KEYS = {"inference": "inference_batch_sweep",
+                "train": "train_batch_sweep",
+                "stack2": "num_stack2", "remat": "remat"}
+
+
+def merge_prior(results: dict, prior: dict, only: set) -> dict:
+    """Carry prior-run records into `results` for sections NOT being rerun.
+
+    A section in `only` starts empty (its records would duplicate on
+    re-append); prior results from a different platform are discarded
+    entirely. Pure so tests/test_bench_helpers.py can pin the semantics.
+    """
+    if prior.get("platform") != results.get("platform"):
+        return results
+    for sec, k in SECTION_KEYS.items():
+        if sec not in only:
+            results[k] = prior.get(k, results[k])
+    return results
+
 
 def main() -> None:
     only = None
@@ -98,15 +118,7 @@ def main() -> None:
     if only and os.path.exists(OUT_PATH):
         with open(OUT_PATH) as f:
             prior = json.load(f)
-        if prior.get("platform") == platform:
-            # keep prior results only for sections NOT being rerun — a rerun
-            # section starts empty, else its records would duplicate
-            section_keys = {"inference": "inference_batch_sweep",
-                            "train": "train_batch_sweep",
-                            "stack2": "num_stack2", "remat": "remat"}
-            for sec, k in section_keys.items():
-                if sec not in only:
-                    results[k] = prior.get(k, results[k])
+        results = merge_prior(results, prior, only)
 
     def flush():
         with open(OUT_PATH, "w") as f:

@@ -1,0 +1,88 @@
+"""Unit tests for the measurement harness the driver depends on.
+
+bench.py is the artifact the judge's driver runs every round and
+scripts/tpu_sweep.py produced the README's throughput table — their helper
+logic (dispatch-overhead subtraction, cost-analysis FLOPs, resume merge)
+deserves the same pinning as the framework ops. All tests run on the CPU
+backend conftest configures; nothing here touches a device claim.
+"""
+
+import importlib.util
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def _load_sweep():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_sweep", os.path.join(REPO, "scripts", "tpu_sweep.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_measure_dispatch_overhead_small_and_positive():
+    ov = bench.measure_dispatch_overhead()
+    assert 0 < ov < 1.0  # CPU dispatch is microseconds; 1 s = badly broken
+
+
+def test_timed_fetch_subtracts_overhead_and_stays_positive():
+    f = jax.jit(lambda x: jnp.sum(x * 2.0))
+    x = jnp.ones((256, 256))
+    float(f(x))  # compile
+    dt = bench.timed_fetch(f, (x,), overhead=0.0)
+    assert dt > 0
+    # an overhead larger than the measurement must clamp, not go negative
+    dt_clamped = bench.timed_fetch(f, (x,), overhead=1e9)
+    assert dt_clamped == 1e-9
+
+
+def test_flops_of_matmul_matches_analytic():
+    n = 128
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.ones((n, n), jnp.float32)
+    compiled = f.lower(a, a).compile()
+    fl = bench.flops_of(compiled)
+    assert fl is not None
+    # XLA counts 2*n^3 (fused multiply-add = 2 flops); allow slack for
+    # version differences in how the epilogue is counted
+    assert 0.5 * 2 * n**3 <= fl <= 2 * 2 * n**3
+
+
+def test_sweep_merge_prior_keeps_only_unrerun_sections():
+    sweep = _load_sweep()
+    fresh = {"platform": "tpu", "inference_batch_sweep": [],
+             "train_batch_sweep": [], "num_stack2": {}, "remat": []}
+    prior = {"platform": "tpu",
+             "inference_batch_sweep": [{"batch": 8, "img_per_sec": 1.0}],
+             "train_batch_sweep": [{"batch": 16, "img_per_sec_chip": 2.0}],
+             "num_stack2": {"train": {"batch": 16}}, "remat": []}
+    out = sweep.merge_prior(dict(fresh), prior, only={"train"})
+    # rerun section starts empty; others carried over
+    assert out["train_batch_sweep"] == []
+    assert out["inference_batch_sweep"] == prior["inference_batch_sweep"]
+    assert out["num_stack2"] == prior["num_stack2"]
+
+
+def test_sweep_merge_prior_discards_other_platform():
+    sweep = _load_sweep()
+    fresh = {"platform": "tpu", "inference_batch_sweep": [],
+             "train_batch_sweep": [], "num_stack2": {}, "remat": []}
+    prior = {"platform": "cpu",
+             "inference_batch_sweep": [{"batch": 1, "img_per_sec": 9.0}]}
+    out = sweep.merge_prior(dict(fresh), prior, only={"train"})
+    assert out["inference_batch_sweep"] == []
+
+
+def test_sweep_section_keys_cover_all_result_lists():
+    sweep = _load_sweep()
+    assert set(sweep.SECTION_KEYS.values()) == {
+        "inference_batch_sweep", "train_batch_sweep", "num_stack2", "remat"}
