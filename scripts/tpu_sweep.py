@@ -66,7 +66,8 @@ def merge_prior(results: dict, prior: dict, only: set) -> dict:
 
     A section in `only` starts empty (its records would duplicate on
     re-append); prior results from a different platform are discarded
-    entirely. Pure so tests/test_bench_helpers.py can pin the semantics.
+    entirely. Mutates and returns `results`; no I/O, so
+    tests/test_bench_helpers.py can pin the semantics directly.
     """
     if prior.get("platform") != results.get("platform"):
         return results
