@@ -9,7 +9,13 @@
 //
 // Usage:
 //   pjrt_runner <plugin.so> <export_dir> [--image raw_f32_file] [--iters N]
-//               [--opt key=value]...
+//               [--depth D] [--opt key=value]...
+//
+// --depth D (default 1) keeps up to D frames in flight: frame i+1 is
+// dispatched before frame i's detections are fetched, so D2H and host
+// consumption overlap device execution — the deployment analogue of the
+// Python side's software-pipelined eval loop. Depth 1 is the strictly
+// sequential mode whose per-frame time is an honest latency measure.
 //
 // --opt passes PJRT_NamedValue client-create options (repeatable). Values
 // parse as int64 when they look like integers, else as strings — e.g. the
@@ -155,17 +161,19 @@ int main(int argc, char** argv) {
   if (argc < 3) {
     std::fprintf(stderr,
                  "usage: %s <plugin.so> <export_dir> [--image f32.raw] "
-                 "[--iters N]\n", argv[0]);
+                 "[--iters N] [--depth D]\n", argv[0]);
     return 2;
   }
   const std::string plugin_path = argv[1];
   const std::string export_dir = argv[2];
   std::string image_path;
   int iters = 20;
+  int depth = 1;
   std::vector<std::pair<std::string, std::string>> create_opts;
   for (int i = 3; i + 1 < argc; i += 2) {
     if (!std::strcmp(argv[i], "--image")) image_path = argv[i + 1];
     else if (!std::strcmp(argv[i], "--iters")) iters = std::atoi(argv[i + 1]);
+    else if (!std::strcmp(argv[i], "--depth")) depth = std::atoi(argv[i + 1]);
     else if (!std::strcmp(argv[i], "--opt")) {
       std::string kv = argv[i + 1];
       auto eq = kv.find('=');
@@ -316,12 +324,20 @@ int main(int argc, char** argv) {
   opts.non_donatable_input_indices = non_donatable;
   opts.num_non_donatable_input_indices = 1;
 
-  std::vector<PJRT_Buffer*> outs(num_outputs, nullptr);
-  PJRT_Buffer** output_list = outs.data();
   PJRT_Buffer* const arg_list[] = {input};
   PJRT_Buffer* const* const argument_lists[] = {arg_list};
 
-  auto run_once = [&](bool keep_outputs) {
+  // One in-flight frame: its (not yet fetched) output buffers + the device
+  // completion event the fetch must wait behind.
+  struct InFlight {
+    std::vector<PJRT_Buffer*> outs;
+    PJRT_Event* done = nullptr;
+  };
+
+  auto dispatch = [&]() {
+    InFlight f;
+    f.outs.assign(num_outputs, nullptr);
+    PJRT_Buffer** output_list = f.outs.data();
     PJRT_LoadedExecutable_Execute_Args eargs;
     std::memset(&eargs, 0, sizeof(eargs));
     eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
@@ -331,11 +347,16 @@ int main(int argc, char** argv) {
     eargs.num_devices = 1;
     eargs.num_args = 1;
     eargs.output_lists = &output_list;
-    PJRT_Event* done = nullptr;
-    PJRT_Event** events = &done;
+    PJRT_Event** events = &f.done;
     eargs.device_complete_events = events;
+    // output buffer pointers are written synchronously during Execute, so
+    // moving f (vector data pointer is move-stable) afterwards is safe
     Check(g_api->PJRT_LoadedExecutable_Execute(&eargs), "execute");
-    Await(done, "execute event");
+    return f;
+  };
+
+  auto complete = [&](InFlight& f, bool keep_outputs) {
+    Await(f.done, "execute event");
     // Deployment semantics: every frame's detections are consumed by the
     // host, so fetch one (tiny) output each iteration. This is also what
     // keeps the timing honest on transports whose completion events
@@ -343,12 +364,12 @@ int main(int argc, char** argv) {
     // tunnel: event-only timing reported 83k img/s for a model whose
     // device latency is 1.5 ms) — D2H cannot complete before the bytes
     // exist.
-    if (num_outputs == 0 || outs[num_outputs - 1] == nullptr)
+    if (num_outputs == 0 || f.outs[num_outputs - 1] == nullptr)
       Die("executable produced no outputs to fetch; timing would be "
           "event-only and unreliable");
-    (void)BufferToHost(outs[num_outputs - 1]);
+    (void)BufferToHost(f.outs[num_outputs - 1]);
     if (!keep_outputs) {
-      for (auto*& b : outs) {
+      for (auto*& b : f.outs) {
         if (!b) continue;
         PJRT_Buffer_Destroy_Args dargs;
         std::memset(&dargs, 0, sizeof(dargs));
@@ -360,22 +381,42 @@ int main(int argc, char** argv) {
     }
   };
 
-  run_once(false);  // warmup
+  {
+    InFlight w = dispatch();  // warmup
+    complete(w, false);
+  }
+  if (depth < 1) depth = 1;
+  // Pipelined timed loop: up to `depth` frames in flight; frame i's fetch
+  // overlaps frame i+1..i+depth-1's execution. depth=1 == sequential.
+  std::vector<InFlight> queue;  // FIFO, small (<= depth)
+  std::vector<PJRT_Buffer*> last_outs;  // kept for detection printing
+  int completed = 0;
+  auto complete_front = [&]() {
+    bool last = completed == iters - 1;  // final frame: keep for printing
+    complete(queue.front(), last);
+    if (last) last_outs = std::move(queue.front().outs);
+    queue.erase(queue.begin());
+    ++completed;
+  };
   t0 = std::chrono::steady_clock::now();
-  for (int i = 0; i < iters; ++i) run_once(i == iters - 1);
+  for (int i = 0; i < iters; ++i) {
+    queue.push_back(dispatch());
+    if (static_cast<int>(queue.size()) >= depth) complete_front();
+  }
+  while (!queue.empty()) complete_front();
   double dt = std::chrono::duration<double>(
       std::chrono::steady_clock::now() - t0).count();
   double fps = shape[0] * iters / dt;
-  std::printf("timing: %d iters, batch %ld: %.2f img/s (%.2f ms/batch, "
-              "incl. per-frame D2H)\n",
-              iters, shape[0], fps, 1000.0 * dt / iters);
+  std::printf("timing: %d iters, batch %ld, depth %d: %.2f img/s "
+              "(%.2f ms/batch, incl. per-frame D2H)\n",
+              iters, shape[0], depth, fps, 1000.0 * dt / iters);
 
   // --- print detections from the last run ----------------------------------
-  if (num_outputs >= 4) {
-    HostOutput boxes = BufferToHost(outs[0]);
-    HostOutput classes = BufferToHost(outs[1]);
-    HostOutput scores = BufferToHost(outs[2]);
-    HostOutput valid = BufferToHost(outs[3]);
+  if (num_outputs >= 4 && last_outs.size() >= 4) {
+    HostOutput boxes = BufferToHost(last_outs[0]);
+    HostOutput classes = BufferToHost(last_outs[1]);
+    HostOutput scores = BufferToHost(last_outs[2]);
+    HostOutput valid = BufferToHost(last_outs[3]);
     const float* bx = reinterpret_cast<const float*>(boxes.bytes.data());
     const int32_t* cl = reinterpret_cast<const int32_t*>(classes.bytes.data());
     const float* sc = reinterpret_cast<const float*>(scores.bytes.data());

@@ -87,7 +87,10 @@ def evaluate(cfg: Config) -> Dict:
     results: Dict[str, Dict] = {}
     gt_boxes: Dict[str, np.ndarray] = {}
     gt_labels: Dict[str, np.ndarray] = {}
-    meters = {k: AverageMeter() for k in ("data", "predict", "consume")}
+    # "dispatch" = async predict dispatch only (not inference latency —
+    # bench.py measures that); "consume" = device_get wait + host box
+    # rescale/txt writes for the previous batch
+    meters = {k: AverageMeter() for k in ("data", "dispatch", "consume")}
 
     imsize = float(cfg.imsize or 512)
     seen = 0
@@ -136,13 +139,12 @@ def evaluate(cfg: Config) -> Dict:
             images = np.concatenate(
                 [images, np.zeros((pad,) + images.shape[1:], images.dtype)])
         dets_dev = predict(variables, jnp.asarray(images))  # async dispatch
-        meters["predict"].update(time.time() - t0)
+        meters["dispatch"].update(time.time() - t0)
         if pending is not None:
             t0 = time.time()
             consume(jax.device_get(pending[0]), pending[1])
             # includes the device_get wait, i.e. any device time not hidden
-            # behind the host work — NOT pure inference latency (bench.py
-            # measures that); "predict" above is dispatch cost only
+            # behind the host work
             meters["consume"].update(time.time() - t0)
         pending = (dets_dev, batch.infos)
 
@@ -150,7 +152,7 @@ def evaluate(cfg: Config) -> Dict:
             print("%s: eval iter %d/%d, data %.3fs dispatch %.3fs "
                   "fetch+consume %.3fs"
                   % (timestamp(), i, len(loader), meters["data"].avg,
-                     meters["predict"].avg, meters["consume"].avg),
+                     meters["dispatch"].avg, meters["consume"].avg),
                   flush=True)
         tic = time.time()
     if pending is not None:

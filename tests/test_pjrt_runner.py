@@ -66,6 +66,20 @@ def test_runner_end_to_end_on_stub_plugin(runner_build, export_dir):
     assert "det[1] cls=1 score=0.800 box=(50.0, 60.0, 70.0, 80.0)" in r.stdout
 
 
+def test_runner_pipelined_depth_matches_sequential(runner_build, export_dir):
+    """--depth 3 keeps frames in flight (fetch of frame i overlaps execute of
+    i+1..i+2); detections and control flow must be identical to depth 1."""
+    runner, stub = runner_build
+    r = subprocess.run([runner, stub, export_dir, "--iters", "5",
+                        "--depth", "3"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+    assert "depth 3" in r.stdout
+    assert "det[0] cls=0 score=0.900 box=(10.0, 20.0, 30.0, 40.0)" in r.stdout
+    assert "det[1] cls=1 score=0.800 box=(50.0, 60.0, 70.0, 80.0)" in r.stdout
+
+
 def test_runner_rejects_bad_export_dir(runner_build, tmp_path):
     runner, stub = runner_build
     r = subprocess.run([runner, stub, str(tmp_path)], capture_output=True,
