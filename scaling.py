@@ -28,7 +28,8 @@ import subprocess
 import sys
 
 
-def child(n: int, per_chip_batch: int, imsize: int, iters: int) -> None:
+def child(n: int, per_chip_batch: int, imsize: int, iters: int,
+          spatial: int = 1) -> None:
     """Measure one device count; prints a single JSON line.
 
     Timing methodology matches bench.py: `iters` steps are scanned INSIDE
@@ -53,6 +54,12 @@ def child(n: int, per_chip_batch: int, imsize: int, iters: int) -> None:
                                                       make_scanned_train_fn,
                                                       make_train_step_body)
 
+    # weak scaling holds per-device work fixed: total pixels per step =
+    # n * per_chip_batch images regardless of mesh shape. In 2D-mesh mode
+    # (--spatial > 1) each image's H is split across `spatial` devices, so
+    # the data axis carries spatial*per_chip_batch images per data-row —
+    # same per-device pixel count, different collective pattern (halo
+    # exchanges for convs on top of the gradient all-reduce).
     batch = n * per_chip_batch
     cfg = Config(num_stack=1,
                  hourglass_inch=128 if imsize >= 256 else 32,
@@ -60,7 +67,7 @@ def child(n: int, per_chip_batch: int, imsize: int, iters: int) -> None:
     model = build_model(cfg)
     tx = build_optimizer(cfg, 100)
     state = create_train_state(model, cfg, jax.random.key(0), imsize, tx)
-    mesh = make_mesh(n)
+    mesh = make_mesh(n, spatial=spatial)
     body = make_train_step_body(model, tx, cfg)
 
     train_n = make_scanned_train_fn(body, iters)
@@ -86,6 +93,7 @@ def child(n: int, per_chip_batch: int, imsize: int, iters: int) -> None:
     dt = timed_fetch(step, (state, *arrs), overhead, repeats=1)
     print(json.dumps({
         "devices": n, "platform": jax.devices()[0].platform,
+        "spatial": spatial,
         "img_per_sec": round(batch * iters / dt, 2),
         "img_per_sec_per_chip": round(per_chip_batch * iters / dt, 2),
         "step_ms": round(dt / iters * 1e3, 2),
@@ -98,6 +106,9 @@ def main() -> None:
     ap.add_argument("--per-chip-batch", type=int, default=None)
     ap.add_argument("--imsize", type=int, default=None)
     ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--spatial", type=int, default=1,
+                    help="spatial-axis size of the 2D (data x spatial) mesh; "
+                         "must divide every device count")
     ap.add_argument("--tpu", action="store_true",
                     help="require the TPU backend (no CPU fallback)")
     ap.add_argument("--cpu", action="store_true",
@@ -107,7 +118,8 @@ def main() -> None:
     args = ap.parse_args()
 
     if args.child is not None:
-        child(args.child, args.per_chip_batch, args.imsize, args.iters)
+        child(args.child, args.per_chip_batch, args.imsize, args.iters,
+              spatial=args.spatial)
         return
 
     # Probe the backend in a throwaway subprocess so a hung TPU tunnel
@@ -137,8 +149,13 @@ def main() -> None:
     imsize = args.imsize or (512 if on_tpu else 64)
     iters = args.iters or (10 if on_tpu else 5)
 
+    counts = [n for n in args.devices if n % args.spatial == 0]
+    for n in set(args.devices) - set(counts):
+        print("[scaling] skipping n=%d: not divisible by --spatial %d"
+              % (n, args.spatial), file=sys.stderr, flush=True)
+
     results = []
-    for n in args.devices:
+    for n in counts:
         env = dict(os.environ)
         use_cpu = not on_tpu or n > n_real
         if use_cpu:
@@ -148,7 +165,7 @@ def main() -> None:
                                 % n).strip()
         cmd = [sys.executable, os.path.abspath(__file__), "--child", str(n),
                "--per-chip-batch", str(per_chip), "--imsize", str(imsize),
-               "--iters", str(iters)]
+               "--iters", str(iters), "--spatial", str(args.spatial)]
         print("[scaling] n=%d (%s)..." % (n, "cpu-virtual" if use_cpu
                                           else "tpu"),
               file=sys.stderr, flush=True)
@@ -166,15 +183,18 @@ def main() -> None:
             continue
         results.append(json.loads(r.stdout.strip().splitlines()[-1]))
 
-    base = next((r["img_per_sec_per_chip"] for r in results
-                 if r.get("devices") == 1 and "img_per_sec_per_chip" in r),
-                None)
+    # efficiency vs the smallest successful device count (n=1 for a 1D data
+    # mesh; n=spatial is the natural floor of a 2D mesh)
+    ok = sorted((r for r in results if "img_per_sec_per_chip" in r),
+                key=lambda r: r["devices"])
+    base = ok[0]["img_per_sec_per_chip"] if ok else None
     for r in results:
         if base and "img_per_sec_per_chip" in r:
             r["efficiency"] = round(r["img_per_sec_per_chip"] / base, 4)
+            r["efficiency_base_devices"] = ok[0]["devices"]
 
     out = {"per_chip_batch": per_chip, "imsize": imsize, "iters": iters,
-           "results": results}
+           "spatial": args.spatial, "results": results}
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))
