@@ -143,6 +143,12 @@ class Config:
     hang_warn_seconds: float = 300.0  # watchdog: warn when no train step
     # completes for this long (0 disables). Remote-TPU transports can
     # wedge mid-run; the reference has no failure detection at all.
+    auto_resume: int = 0          # elastic recovery: on a transient backend
+    # failure, back off, restore the newest checkpoint in save-path and
+    # continue in-process, up to N times (0 disables; single-host only).
+    # The reference's only recovery is a manual restart (its train.py:190).
+    fault_inject: str = ""        # debug: "EPOCH:ITER" raises one synthetic
+    # transient backend error at that step, to exercise --auto-resume
     save_path: str = "./WEIGHTS/"
     profile: bool = False         # jax.profiler trace of early train steps
 

@@ -390,8 +390,17 @@ def load_checkpoint(path: str, state: TrainState):
                       "batch_stats": state.batch_stats,
                       "opt_state": state.opt_state},
             "epoch": 0}
-    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
-                            jax.device_get(item))
+
+    # Abstract target from array AVALS, never buffers: `state` may hold
+    # DONATED (deleted) arrays when restoring inside the --auto-resume
+    # handler after a mid-step failure — shape/dtype metadata survives
+    # deletion, a device_get would raise (or hang on a wedged backend).
+    def _abstract(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x  # python scalars (epoch) restore by example
+
+    abstract = jax.tree.map(_abstract, item)
     try:
         raw_ckpt = ocp.StandardCheckpointer().restore(apath, abstract)
     except FileNotFoundError:
@@ -568,11 +577,60 @@ class HangWatchdog:
         self._stop.set()
 
 
+class InjectedBackendError(RuntimeError):
+    """Synthetic transient backend failure raised by FaultInjector."""
+
+
+class FaultInjector:
+    """Debug fault injection: raise ONE synthetic transient backend error
+    at a given "EPOCH:ITER" (--fault-inject). The reference has no fault
+    injection at all (SURVEY.md §5); this exists so the --auto-resume
+    recovery path is testable without a real backend outage."""
+
+    def __init__(self, spec: str = ""):
+        if spec:
+            parts = spec.split(":")
+            if len(parts) != 2:
+                raise ValueError(
+                    "--fault-inject wants 'EPOCH:ITER', got %r" % spec)
+            self.target = (int(parts[0]), int(parts[1]))
+        else:
+            self.target = None
+        self.fired = False
+
+    def maybe_fire(self, epoch: int, i: int) -> None:
+        if self.target is not None and not self.fired \
+                and (epoch, i) == self.target:
+            self.fired = True
+            raise InjectedBackendError(
+                "injected backend fault at epoch %d iter %d (UNAVAILABLE)"
+                % (epoch, i))
+
+
+# Status markers that identify a device/transport failure worth retrying
+# (vs a programming error, which must propagate). Matched against
+# XlaRuntimeError/RuntimeError messages.
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "INTERNAL",
+                      "Unable to initialize backend", "Socket closed",
+                      "connection")
+
+
+def is_transient_backend_error(e: BaseException) -> bool:
+    """Would retrying after a backend re-init plausibly succeed?"""
+    if isinstance(e, InjectedBackendError):
+        return True
+    if type(e).__name__ not in ("XlaRuntimeError", "RuntimeError"):
+        return False
+    msg = str(e)
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
 def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
                 state: TrainState, mesh, loss_log: LossLog,
                 is_chief: bool = True, snapshot_fn=None,
                 profile_this_epoch: bool = False,
-                epoch_base_step: int = 0, watchdog=None) -> TrainState:
+                epoch_base_step: int = 0, watchdog=None,
+                injector: Optional[FaultInjector] = None) -> TrainState:
     """One epoch of the hot loop (≡ ref train.py:86-162 `train_step`)."""
     meters = {k: AverageMeter() for k in ("data", "step")}
     loader.set_epoch(epoch)
@@ -595,6 +653,8 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
 
     tic = time.time()
     for i, batch in enumerate(loader):
+        if injector is not None:
+            injector.maybe_fire(epoch, i)
         data_t = time.time() - tic
         meters["data"].update(data_t)
 
@@ -738,35 +798,105 @@ def train(cfg: Config) -> TrainState:
         # the chief-only device-side snapshot + orbax save would touch
         # non-addressable devices / hang the multi-host save barrier
         raise ValueError("--async-ckpt is single-host only")
+    if cfg.auto_resume and jax.process_count() > 1:
+        # in-process recovery would need cross-host coordination (all
+        # processes must restore the same checkpoint + re-rendezvous);
+        # multi-host recovery = restart the job with --model-load
+        raise ValueError("--auto-resume is single-host only")
+    if cfg.auto_resume and cfg.async_ckpt:
+        # recovery must restore a DURABLE checkpoint; an async save may
+        # still be in flight (or half-written) at the moment of failure
+        raise ValueError("--auto-resume requires synchronous checkpoints "
+                         "(drop --async-ckpt)")
     watchdog = HangWatchdog(cfg.hang_warn_seconds)
     writer = CheckpointWriter(async_save=cfg.async_ckpt)
+    injector = FaultInjector(cfg.fault_inject)
+    resume_attempts = 0
+    run_ckpts: list = []  # checkpoints written by THIS run, oldest first
+    epoch = start_epoch
     try:
-        for epoch in range(start_epoch, cfg.end_epoch):
-            state = train_epoch(cfg, epoch, loader, runner, state, mesh,
-                                loss_log, is_chief, snapshot_fn,
-                                profile_this_epoch=(cfg.profile
-                                                    and epoch == start_epoch),
-                                epoch_base_step=epoch * steps_per_epoch,
-                                watchdog=watchdog)
-            # every N epochs + always the final one (a full-state save costs
-            # a device_get of params+optimizer — seconds over a remote
-            # tunnel)
-            if (epoch + 1) % max(1, cfg.ckpt_interval) == 0 \
-                    or epoch == cfg.end_epoch - 1:
-                # warnings are suspended across the save on EVERY process:
-                # the chief's full-state device_get can legitimately take
-                # minutes, and non-chief processes spend that time blocked
-                # at the next collective — neither is a hang. (A non-chief
-                # resumes immediately and re-pauses nothing: its block
-                # inside the first post-boundary step cannot be
-                # distinguished from a wedge without cross-host signaling,
-                # so the boundary pause is the best local approximation.)
-                watchdog.pause("epoch %d boundary (checkpoint)" % epoch)
-                if is_chief:
-                    path = writer.save(cfg.save_path, epoch, state, loss_log)
-                    print("%s: epoch %d checkpoint -> %s"
-                          % (timestamp(), epoch, path), flush=True)
-                watchdog.resume("epoch %d checkpoint done" % epoch)
+        while epoch < cfg.end_epoch:
+            try:
+                state = train_epoch(
+                    cfg, epoch, loader, runner, state, mesh,
+                    loss_log, is_chief, snapshot_fn,
+                    profile_this_epoch=(cfg.profile and epoch == start_epoch),
+                    epoch_base_step=epoch * steps_per_epoch,
+                    watchdog=watchdog, injector=injector)
+                # every N epochs + always the final one (a full-state save
+                # costs a device_get of params+optimizer — seconds over a
+                # remote tunnel)
+                if (epoch + 1) % max(1, cfg.ckpt_interval) == 0 \
+                        or epoch == cfg.end_epoch - 1:
+                    # warnings are suspended across the save on EVERY
+                    # process: the chief's full-state device_get can
+                    # legitimately take minutes, and non-chief processes
+                    # spend that time blocked at the next collective —
+                    # neither is a hang. (A non-chief resumes immediately
+                    # and re-pauses nothing: its block inside the first
+                    # post-boundary step cannot be distinguished from a
+                    # wedge without cross-host signaling, so the boundary
+                    # pause is the best local approximation.)
+                    watchdog.pause("epoch %d boundary (checkpoint)" % epoch)
+                    if is_chief:
+                        path = writer.save(cfg.save_path, epoch, state,
+                                           loss_log)
+                        run_ckpts.append(path)
+                        print("%s: epoch %d checkpoint -> %s"
+                              % (timestamp(), epoch, path), flush=True)
+                    watchdog.resume("epoch %d checkpoint done" % epoch)
+            except Exception as e:  # noqa: BLE001 — filtered just below
+                # Elastic recovery (--auto-resume N; the reference's only
+                # recovery is a manual restart with --model-load, ref
+                # train.py:190-199): on a TRANSIENT backend failure, back
+                # off, restore the newest checkpoint, and continue the
+                # epoch loop in-process. Anything non-transient (or beyond
+                # the attempt budget) propagates.
+                if not (cfg.auto_resume
+                        and resume_attempts < cfg.auto_resume
+                        and is_transient_backend_error(e)):
+                    raise
+                resume_attempts += 1
+                wait = min(300.0, 15.0 * resume_attempts)
+                print("%s: transient backend failure in epoch %d (%s: %s); "
+                      "recovery %d/%d in %.0fs"
+                      % (timestamp(), epoch, type(e).__name__,
+                         str(e).splitlines()[0][:200], resume_attempts,
+                         cfg.auto_resume, wait), flush=True)
+                watchdog.pause("auto-resume backoff")
+                time.sleep(wait)
+                # only checkpoints written by THIS run are trusted: a
+                # reused save_path can hold a previous run's (possibly
+                # later-epoch) checkpoints, which would silently replace
+                # this run's weights or end training early
+                if run_ckpts:
+                    latest = run_ckpts[-1]
+                    state, ckpt_epoch, loss_log = load_checkpoint(latest,
+                                                                  state)
+                    epoch = ckpt_epoch + 1
+                    print("%s: auto-resumed from %s (epoch %d)"
+                          % (timestamp(), latest, ckpt_epoch), flush=True)
+                elif cfg.model_load:
+                    # failed before this run's first save: fall back to the
+                    # weights the run STARTED from, exactly as at entry
+                    state, ckpt_epoch, loss_log = load_checkpoint(
+                        cfg.model_load, state)
+                    epoch = cfg.start_epoch or (ckpt_epoch + 1)
+                    print("%s: no checkpoint from this run yet; "
+                          "auto-resumed from --model-load %s (epoch %d)"
+                          % (timestamp(), cfg.model_load, epoch), flush=True)
+                else:
+                    # fresh run, failed before the first save: re-init
+                    state = create_train_state(
+                        model, cfg, jax.random.key(cfg.random_seed), imsize,
+                        tx)
+                    loss_log = LossLog()
+                    epoch = start_epoch
+                    print("%s: no checkpoint yet; auto-restarting from "
+                          "epoch %d" % (timestamp(), epoch), flush=True)
+                watchdog.resume("auto-resume restored")
+                continue
+            epoch += 1
     finally:
         watchdog.pause("finalizing checkpoints")
         writer.finalize()
