@@ -143,6 +143,9 @@ class Config:
     hang_warn_seconds: float = 300.0  # watchdog: warn when no train step
     # completes for this long (0 disables). Remote-TPU transports can
     # wedge mid-run; the reference has no failure detection at all.
+    prewarm: bool = False         # compile every multiscale bucket before
+    # epoch 0 (device-augment paths): each bucket's first XLA compile
+    # otherwise stalls a mid-epoch step 20-40s on a remote-TPU transport
     auto_resume: int = 0          # elastic recovery: on a transient backend
     # failure, back off, restore the newest checkpoint in save-path and
     # continue in-process, up to N times (0 disables; single-host only).

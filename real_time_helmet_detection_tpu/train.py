@@ -490,27 +490,72 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
     # extra per-step dispatches, which cost ~70 ms each on a remote tunnel)
     base_key = jax.device_put(base_key, replicated(mesh))
 
+    def prewarm(state, call_bucket):
+        """Compile every multiscale bucket BEFORE the steady-state loop
+        (`--prewarm`): each bucket's first compile otherwise stalls a
+        mid-epoch step for the full XLA compile (20-40 s per bucket over a
+        remote-TPU transport). Runs each bucket's jitted step once on
+        zero-filled dummy inputs with a SACRIFICIAL copy of the state (the
+        step donates its state argument), so the real state and the jit
+        dispatch caches are both left in exactly the production call path.
+        """
+        # ONE jitted copy, then chain: bucket i's output state (same avals
+        # and shardings as production) is bucket i+1's sacrificial input.
+        # Per-leaf eager copies would cost one ~70 ms tunnel dispatch per
+        # leaf per bucket — rivaling the compile stall being hidden.
+        sacrificial = jax.jit(lambda s: jax.tree.map(jnp.copy, s))(state)
+        for target in sizes:
+            t0 = time.time()
+            sacrificial, _ = call_bucket(sacrificial, target)
+            jax.block_until_ready(jax.tree.leaves(sacrificial)[0])
+            print("%s: prewarmed bucket %d (%.1fs)"
+                  % (timestamp(), target, time.time() - t0), flush=True)
+
     if cache is not None:
-        def runner(state, idx_batch, step_idx):
-            target = pick_target(step_idx)
+        def get_step(target):
             if target not in steps:
                 steps[target] = make_cached_device_train_step(
                     model, tx, cfg, mesh, target, cache)
-            return steps[target](state, base_key, np.int32(step_idx),
-                                 np.asarray(idx_batch, np.int32))
+            return steps[target]
 
+        def runner(state, idx_batch, step_idx):
+            return get_step(pick_target(step_idx))(
+                state, base_key, np.int32(step_idx),
+                np.asarray(idx_batch, np.int32))
+
+        runner.prewarm = lambda state: prewarm(
+            state, lambda st, target: get_step(target)(
+                st, base_key, np.int32(0),
+                np.zeros((cfg.batch_size,), np.int32)))
+        runner.steps = steps  # bucket -> jitted step (tests assert coverage)
         return runner
 
-    def runner(state, batch, step_idx):
-        target = pick_target(step_idx)
+    def get_step(target):
         if target not in steps:
             steps[target] = make_device_train_step(model, tx, cfg, mesh,
                                                    target)
+        return steps[target]
+
+    def runner(state, batch, step_idx):
         images, boxes, labels, valid = shard_batch(
             mesh, (batch.image, batch.boxes, batch.labels, batch.valid))
-        return steps[target](state, base_key, np.int32(step_idx), images,
-                             boxes, labels, valid)
+        return get_step(pick_target(step_idx))(
+            state, base_key, np.int32(step_idx), images, boxes, labels,
+            valid)
 
+    def _dummy_call(st, target):
+        canvas = cfg.multiscale[1]
+        local_b = cfg.batch_size // jax.process_count()
+        dummy = (np.zeros((local_b, canvas, canvas, 3), np.uint8),
+                 np.zeros((local_b, cfg.max_boxes, 4), np.float32),
+                 np.zeros((local_b, cfg.max_boxes), np.int32),
+                 np.zeros((local_b, cfg.max_boxes), bool))
+        images, boxes, labels, valid = shard_batch(mesh, dummy)
+        return get_step(target)(st, base_key, np.int32(0), images, boxes,
+                                labels, valid)
+
+    runner.prewarm = lambda state: prewarm(state, _dummy_call)
+    runner.steps = steps  # bucket -> jitted step (tests assert coverage)
     return runner
 
 
@@ -787,6 +832,11 @@ def train(cfg: Config) -> TrainState:
                   % (timestamp(), cfg.model_load, ckpt_epoch), flush=True)
 
     runner = make_step_runner(cfg, mesh, model, tx, cache=cache)
+    if cfg.prewarm and hasattr(runner, "prewarm"):
+        print("%s: prewarming %s multiscale buckets..."
+              % (timestamp(), "all" if cfg.multiscale_flag else "1"),
+              flush=True)
+        runner.prewarm(state)
     snapshot_fn = (make_snapshot_fn(model, cfg)
                    if is_chief and not cfg.device_augment else None)
     if is_chief:

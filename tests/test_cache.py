@@ -149,3 +149,56 @@ def test_cache_drop_last_false_pads_by_wrapping(fixture_root):
     assert all(c.shape == (4,) for c in chunks)
     np.testing.assert_array_equal(np.concatenate(chunks),
                                   [0, 1, 2, 3, 4, 5, 0, 1])
+
+
+def test_prewarm_compiles_all_buckets_without_corrupting_state(fixture_root):
+    """`--prewarm` runs every multiscale bucket once on dummy data with a
+    sacrificial state copy: afterwards the REAL state must produce
+    bit-identical losses to an un-prewarmed run, and every bucket must be
+    in the runner's step table (no mid-epoch compiles left)."""
+    cfg = tiny_cfg(multiscale_flag=True, multiscale=[64, 192, 64],
+                   prewarm=True)
+    ds = VOCDataset(fixture_root, image_set="trainval")
+    aug = TestAugmentor(192)
+    mesh = make_mesh(1)
+    model = build_model(cfg)
+    tx = build_optimizer(cfg, 3)
+
+    def run(do_prewarm: bool):
+        state = create_train_state(model, cfg, jax.random.key(0), 64, tx)
+        loader = BatchLoader(ds, aug, batch_size=2, max_boxes=cfg.max_boxes,
+                             shuffle=True, drop_last=True,
+                             seed=cfg.random_seed, num_workers=0, raw=True)
+        runner = make_step_runner(cfg, mesh, model, tx)
+        if do_prewarm:
+            runner.prewarm(state)
+            # every bucket compiled up front -> no mid-epoch compiles left
+            assert set(runner.steps) == {64, 128}
+        loader.set_epoch(0)
+        losses = []
+        for i, batch in enumerate(loader):
+            state, loss = runner(state, batch, i)
+            losses.append(float(jax.device_get(loss["total"])))
+        return losses
+
+    np.testing.assert_array_equal(np.asarray(run(False)),
+                                  np.asarray(run(True)))
+
+
+def test_prewarm_cached_path(fixture_root):
+    cfg = tiny_cfg(multiscale_flag=True, multiscale=[64, 192, 64],
+                   prewarm=True)
+    ds = VOCDataset(fixture_root, image_set="trainval")
+    mesh = make_mesh(1)
+    model = build_model(cfg)
+    tx = build_optimizer(cfg, 3)
+    cache = DeviceDatasetCache(ds, TestAugmentor(192), batch_size=2,
+                               max_boxes=cfg.max_boxes, seed=cfg.random_seed,
+                               mesh=mesh)
+    runner = make_step_runner(cfg, mesh, model, tx, cache=cache)
+    state = create_train_state(model, cfg, jax.random.key(0), 64, tx)
+    runner.prewarm(state)
+    cache.set_epoch(0)
+    for i, batch in enumerate(cache):
+        state, losses = runner(state, batch, i)
+    assert np.isfinite(float(jax.device_get(losses["total"])))
