@@ -14,7 +14,6 @@ imgaug objects; augmentation and GT encoding live in `augment.py` /
 
 from __future__ import annotations
 
-import collections
 import os
 import time
 import xml.etree.ElementTree as ET
@@ -28,24 +27,30 @@ INDEX2CLASS = {0: "hat", 1: "person"}
 CLASS2COLOR = {0: (255, 0, 0), 1: (0, 255, 0)}
 
 
+def _element_value(node: ET.Element):
+    """Value of one XML element: stripped text for a leaf; for an interior
+    node, a dict keyed by child tag where a tag seen once maps to its value
+    and a repeated tag maps to the list of values. The `object` children of
+    `annotation` are ALWAYS a list (possibly empty), whatever their count —
+    consumers iterate detections without special-casing one-object images.
+    """
+    if len(node) == 0:
+        return (node.text or "").strip()
+    grouped: Dict[str, List] = {}
+    for child in node:
+        grouped.setdefault(child.tag, []).append(_element_value(child))
+    value = {tag: vals[0] if len(vals) == 1 else vals
+             for tag, vals in grouped.items()}
+    if node.tag == "annotation":
+        value["object"] = grouped.get("object", [])
+    return value
+
+
 def parse_voc_xml(node: ET.Element) -> Dict:
-    """Recursive XML -> nested dict (ref data.py:65-80)."""
-    voc_dict: Dict = {}
-    children = list(node)
-    if children:
-        def_dic = collections.defaultdict(list)
-        for dc in map(parse_voc_xml, children):
-            for ind, v in dc.items():
-                def_dic[ind].append(v)
-        if node.tag == "annotation":
-            def_dic["object"] = [def_dic["object"]]
-        voc_dict = {node.tag: {ind: v[0] if len(v) == 1 else v
-                               for ind, v in def_dic.items()}}
-    if node.text:
-        text = node.text.strip()
-        if not children:
-            voc_dict[node.tag] = text
-    return voc_dict
+    """XML -> nested dict in the VOCDetection convention the reference's
+    data layer consumes (ref data.py:65-80): the returned dict has one key
+    (the element tag) whose value follows `_element_value`'s rules."""
+    return {node.tag: _element_value(node)}
 
 
 def boxes_from_voc_dict(voc_dict: Dict) -> Tuple[np.ndarray, np.ndarray]:
@@ -53,13 +58,18 @@ def boxes_from_voc_dict(voc_dict: Dict) -> Tuple[np.ndarray, np.ndarray]:
     (ref data.py:55-63)."""
     boxes: List[List[int]] = []
     labels: List[int] = []
-    # parse_voc_xml wraps the object list as [[obj1, ..]] then unwraps the
-    # singleton outer list, so this is already the flat list of object dicts.
+    # always a flat list of object dicts (see _element_value's annotation
+    # special case)
     objects = voc_dict.get("annotation", {}).get("object", [])
-    if isinstance(objects, dict):  # defensive: bare dict if ever unwrapped
+    if isinstance(objects, dict):  # defensive: bare dict from foreign input
         objects = [objects]
     for obj in objects:
-        if not obj:
+        # skip placeholder objects (e.g. <object><name/><bndbox/></object>
+        # from some labeling tools): empty name or childless bndbox parse
+        # to "" — a genuinely unknown class name still raises (parity with
+        # the reference's KeyError, ref data.py:60)
+        if not isinstance(obj, dict) or not obj.get("name") \
+                or not isinstance(obj.get("bndbox"), dict):
             continue
         labels.append(CLASS2INDEX[obj["name"].lower()])
         bb = obj["bndbox"]

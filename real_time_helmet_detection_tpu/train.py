@@ -503,13 +503,18 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
         # and shardings as production) is bucket i+1's sacrificial input.
         # Per-leaf eager copies would cost one ~70 ms tunnel dispatch per
         # leaf per bucket — rivaling the compile stall being hidden.
+        chief = jax.process_index() == 0
         sacrificial = jax.jit(lambda s: jax.tree.map(jnp.copy, s))(state)
         for target in sizes:
             t0 = time.time()
             sacrificial, _ = call_bucket(sacrificial, target)
             jax.block_until_ready(jax.tree.leaves(sacrificial)[0])
-            print("%s: prewarmed bucket %d (%.1fs)"
-                  % (timestamp(), target, time.time() - t0), flush=True)
+            if chief:
+                # host-visible time: dominated by the (synchronous) XLA
+                # compile; on transports whose completion events resolve
+                # early the dummy step's execution may land later
+                print("%s: prewarmed bucket %d (compile+dispatch %.1fs)"
+                      % (timestamp(), target, time.time() - t0), flush=True)
 
     if cache is not None:
         def get_step(target):
@@ -832,11 +837,17 @@ def train(cfg: Config) -> TrainState:
                   % (timestamp(), cfg.model_load, ckpt_epoch), flush=True)
 
     runner = make_step_runner(cfg, mesh, model, tx, cache=cache)
-    if cfg.prewarm and hasattr(runner, "prewarm"):
-        print("%s: prewarming %s multiscale buckets..."
-              % (timestamp(), "all" if cfg.multiscale_flag else "1"),
-              flush=True)
-        runner.prewarm(state)
+    if cfg.prewarm:
+        if hasattr(runner, "prewarm"):
+            if is_chief:
+                print("%s: prewarming %s multiscale buckets..."
+                      % (timestamp(), "all" if cfg.multiscale_flag else "1"),
+                      flush=True)
+            runner.prewarm(state)
+        elif is_chief:
+            print("%s: --prewarm has no effect without --device-augment "
+                  "(the host path has a single fixed-shape step)"
+                  % timestamp(), flush=True)
     snapshot_fn = (make_snapshot_fn(model, cfg)
                    if is_chief and not cfg.device_augment else None)
     if is_chief:

@@ -188,3 +188,22 @@ def test_loader_iteration_deterministic_under_threads(tmp_path):
         np.testing.assert_array_equal(ia, ib)
         np.testing.assert_array_equal(ba, bb)
         assert na == nb
+
+
+def test_parser_skips_placeholder_objects():
+    """<object><name/><bndbox/></object> placeholders (some labeling tools
+    emit them) are skipped; real objects in the same file survive."""
+    import xml.etree.ElementTree as ET
+
+    from real_time_helmet_detection_tpu.data.voc import (boxes_from_voc_dict,
+                                                         parse_voc_xml)
+    x = ("<annotation><filename>p.jpg</filename>"
+         "<size><width>4</width><height>4</height><depth>3</depth></size>"
+         "<object><name/><bndbox/></object>"
+         "<object><name>hat</name><bndbox><xmin>1</xmin><ymin>2</ymin>"
+         "<xmax>3</xmax><ymax>4</ymax></bndbox></object></annotation>")
+    d = parse_voc_xml(ET.fromstring(x))
+    assert len(d["annotation"]["object"]) == 2  # parser keeps both
+    b, l = boxes_from_voc_dict(d)               # consumer skips placeholder
+    assert b.tolist() == [[1.0, 2.0, 3.0, 4.0]]
+    assert l.tolist() == [0]
