@@ -134,6 +134,9 @@ class Config:
     print_interval: int = 100
     ckpt_interval: int = 1        # checkpoint every N epochs (final epoch
     # always saved); the reference saves every epoch (its train.py:76)
+    keep_ckpt: int = 0            # retain only the newest N checkpoints of
+    # THIS run (0 = keep all, the reference's behavior); never touches
+    # checkpoints from other runs in the same save-path
     async_ckpt: bool = False      # overlap checkpoint D2H+write with the
     # next epoch's training (orbax AsyncCheckpointer). Single-host only;
     # transiently holds a second on-device copy of the train state, so

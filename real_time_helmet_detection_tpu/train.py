@@ -905,6 +905,25 @@ def train(cfg: Config) -> TrainState:
                         run_ckpts.append(path)
                         print("%s: epoch %d checkpoint -> %s"
                               % (timestamp(), epoch, path), flush=True)
+                        # Retention applies to THIS run's checkpoints only.
+                        # Async mode keeps one extra: the newest save may
+                        # still be in flight (save() awaits only the
+                        # PREVIOUS one), so the last durable checkpoint
+                        # must survive until the next boundary.
+                        n_keep = cfg.keep_ckpt + (1 if cfg.async_ckpt
+                                                  else 0)
+                        if cfg.keep_ckpt > 0 and len(run_ckpts) > n_keep:
+                            import shutil
+                            for old in run_ckpts[:-n_keep]:
+                                try:
+                                    shutil.rmtree(old)
+                                    print("%s: retention: removed %s"
+                                          % (timestamp(), old), flush=True)
+                                except OSError as rm_err:
+                                    print("%s: retention: could not remove "
+                                          "%s: %s" % (timestamp(), old,
+                                                      rm_err), flush=True)
+                            del run_ckpts[:-n_keep]
                     watchdog.resume("epoch %d checkpoint done" % epoch)
             except Exception as e:  # noqa: BLE001 — filtered just below
                 # Elastic recovery (--auto-resume N; the reference's only

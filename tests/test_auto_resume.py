@@ -115,3 +115,23 @@ def test_fault_without_auto_resume_propagates(fixture_root, tmp_path):
                end_epoch=1)
     with pytest.raises(InjectedBackendError):
         train(cfg)
+
+
+@pytest.mark.slow
+def test_keep_ckpt_retention_with_recovery(fixture_root, tmp_path, capsys):
+    """--keep-ckpt 1: only the newest checkpoint of this run survives; a
+    fault AFTER retention pruned older saves must recover from the still-
+    existing newest one (check_point_1 is deleted by then, so restoring it
+    would crash)."""
+    from real_time_helmet_detection_tpu.train import train
+
+    save = str(tmp_path / "w")
+    cfg = _cfg(fixture_root, save, keep_ckpt=1, auto_resume=1,
+               fault_inject="2:0")
+    state = train(cfg)
+    out = capsys.readouterr().out
+    assert "retention: removed" in out
+    assert "auto-resumed from" in out and "check_point_2" in out
+    assert int(state.step) == 3 * (6 // 2)
+    kept = sorted(d for d in os.listdir(save) if d.startswith("check_point"))
+    assert kept == ["check_point_3"]
