@@ -146,6 +146,11 @@ class Config:
     hang_warn_seconds: float = 300.0  # watchdog: warn when no train step
     # completes for this long (0 disables). Remote-TPU transports can
     # wedge mid-run; the reference has no failure detection at all.
+    ema_decay: float = 0.0        # keep an exponential moving average of
+    # the params inside the jitted step (0 disables). EMA weights usually
+    # evaluate to higher mAP; a capability the reference lacks.
+    ema_eval: bool = False        # evaluate/demo/export with the EMA
+    # weights from the checkpoint (requires a --ema-decay training run)
     prewarm: bool = False         # compile every multiscale bucket before
     # epoch 0 (device-augment paths): each bucket's first XLA compile
     # otherwise stalls a mid-epoch step 20-40s on a remote-TPU transport

@@ -51,8 +51,8 @@ def load_eval_state(cfg: Config) -> Tuple:
     params, batch_stats = init_variables(model, jax.random.key(cfg.random_seed),
                                          imsize)
     if cfg.model_load:
-        params, batch_stats = restore_variables(cfg.model_load, params,
-                                                batch_stats)
+        params, batch_stats = restore_variables(
+            cfg.model_load, params, batch_stats, prefer_ema=cfg.ema_eval)
     return model, {"params": params, "batch_stats": batch_stats}
 
 

@@ -49,11 +49,18 @@ from .utils import AverageMeter, blend_heatmap, timestamp
 
 
 class TrainState(struct.PyTreeNode):
-    """Pure-pytree training state (checkpointable as-is)."""
+    """Pure-pytree training state (checkpointable as-is).
+
+    `ema_params` (populated when `--ema-decay` > 0, else None) is an
+    exponential moving average of `params`, updated inside the jitted
+    step; `--ema-eval` evaluates with it. A capability the reference
+    lacks — EMA weights typically score higher mAP than the raw ones.
+    """
     step: jax.Array
     params: Any
     batch_stats: Any
     opt_state: Any
+    ema_params: Any = None
 
 
 def split_stack_predictions(out: jax.Array, num_cls: int,
@@ -84,8 +91,13 @@ def create_train_state(model, cfg: Config, rng: jax.Array, imsize: int,
     """Initialize params/batch-stats/optimizer (≡ ref train.py:164-187
     `load_network` fresh path)."""
     params, batch_stats = init_variables(model, rng, imsize)
+    # EMA starts as a DISTINCT copy of params (one jitted call): aliasing
+    # the same buffers would make the donating train step donate them twice
+    ema = (jax.jit(lambda p: jax.tree.map(jnp.copy, p))(params)
+           if cfg.ema_decay > 0 else None)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                      batch_stats=batch_stats, opt_state=tx.init(params))
+                      batch_stats=batch_stats, opt_state=tx.init(params),
+                      ema_params=ema)
 
 
 def loss_fn(params, batch_stats, model, images, gt_heat, gt_off, gt_wh, mask,
@@ -109,6 +121,22 @@ def loss_fn(params, batch_stats, model, images, gt_heat, gt_off, gt_wh, mask,
     return totals["total"], (mutated.get("batch_stats", batch_stats), totals)
 
 
+def _optimizer_update(state: TrainState, tx, cfg: Config, grads,
+                      batch_stats) -> TrainState:
+    """Shared update tail of every train-step body: optimizer step + EMA
+    stream (when --ema-decay is on) + step counter. One implementation so
+    the host, device-augment and cached input paths cannot drift."""
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    ema = state.ema_params
+    if cfg.ema_decay > 0 and ema is not None:
+        d = cfg.ema_decay
+        ema = jax.tree.map(lambda e, p: d * e + (1.0 - d) * p, ema, params)
+    return state.replace(step=state.step + 1, params=params,
+                         batch_stats=batch_stats, opt_state=opt_state,
+                         ema_params=ema)
+
+
 def make_train_step_body(model, tx, cfg: Config):
     """The un-jitted train-step body: fwd + bwd + optimizer update.
 
@@ -121,12 +149,7 @@ def make_train_step_body(model, tx, cfg: Config):
         (_, (batch_stats, losses)), grads = grad_fn(
             state.params, state.batch_stats, model, images, gt_heat, gt_off,
             gt_wh, mask, cfg)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        new_state = state.replace(step=state.step + 1, params=params,
-                                  batch_stats=batch_stats,
-                                  opt_state=opt_state)
-        return new_state, losses
+        return _optimizer_update(state, tx, cfg, grads, batch_stats), losses
 
     return step
 
@@ -201,11 +224,7 @@ def make_device_step_body(model, tx, cfg: Config, target: int):
         (_, (batch_stats, losses)), grads = grad_fn(
             state.params, state.batch_stats, model, img, heat, off, wh, mask,
             cfg)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return state.replace(step=state.step + 1, params=params,
-                             batch_stats=batch_stats,
-                             opt_state=opt_state), losses
+        return _optimizer_update(state, tx, cfg, grads, batch_stats), losses
 
     return step
 
@@ -273,11 +292,14 @@ def _write_loss_log(path: str, log_state: dict) -> None:
 
 def _checkpoint_item(epoch: int, state: TrainState) -> dict:
     # plain nested dicts: restorable without reconstructing TrainState /
-    # optimizer pytree types first (see _restore_raw)
-    return {"state": {"step": state.step, "params": state.params,
-                      "batch_stats": state.batch_stats,
-                      "opt_state": state.opt_state},
-            "epoch": epoch}
+    # optimizer pytree types first (see _restore_raw). ema_params rides
+    # along only when EMA is on, so the on-disk format is unchanged
+    # otherwise.
+    st = {"step": state.step, "params": state.params,
+          "batch_stats": state.batch_stats, "opt_state": state.opt_state}
+    if state.ema_params is not None:
+        st["ema_params"] = state.ema_params
+    return {"state": st, "epoch": epoch}
 
 
 def save_checkpoint(save_path: str, epoch: int, state: TrainState,
@@ -386,11 +408,6 @@ def load_checkpoint(path: str, state: TrainState):
     apath = os.path.abspath(path)
     if not os.path.isdir(apath):
         raise FileNotFoundError("checkpoint directory not found: %s" % apath)
-    item = {"state": {"step": state.step, "params": state.params,
-                      "batch_stats": state.batch_stats,
-                      "opt_state": state.opt_state},
-            "epoch": 0}
-
     # Abstract target from array AVALS, never buffers: `state` may hold
     # DONATED (deleted) arrays when restoring inside the --auto-resume
     # handler after a mid-step failure — shape/dtype metadata survives
@@ -400,33 +417,75 @@ def load_checkpoint(path: str, state: TrainState):
             return jax.ShapeDtypeStruct(x.shape, x.dtype)
         return x  # python scalars (epoch) restore by example
 
-    abstract = jax.tree.map(_abstract, item)
+    def _attempt(with_ema: bool):
+        item = _checkpoint_item(0, state)
+        if with_ema:
+            item["state"].setdefault("ema_params", state.params)  # avals
+        else:
+            item["state"].pop("ema_params", None)
+        return ocp.StandardCheckpointer().restore(
+            apath, jax.tree.map(_abstract, item))
+
+    # The checkpoint may disagree with this run about EMA (resuming a
+    # pre-EMA checkpoint with --ema-decay, or an EMA checkpoint without):
+    # try the run's shape first, then the opposite, and reconcile below.
+    want_ema = state.ema_params is not None
+    disk_ema = want_ema
     try:
-        raw_ckpt = ocp.StandardCheckpointer().restore(apath, abstract)
+        raw_ckpt = _attempt(want_ema)
     except FileNotFoundError:
         raise
     except Exception as e:
-        raise ValueError(
-            "Checkpoint at %s does not match the current model/optimizer "
-            "configuration (--optim/--sub-divisions/architecture): %s"
-            % (path, e)) from e
+        try:
+            raw_ckpt = _attempt(not want_ema)
+            disk_ema = not want_ema
+        except Exception:
+            raise ValueError(
+                "Checkpoint at %s does not match the current model/"
+                "optimizer configuration (--optim/--sub-divisions/"
+                "architecture): %s" % (path, e)) from e
     restored = raw_ckpt["state"]
+    if want_ema and not disk_ema:
+        # enabling EMA mid-run: seed the stream from the restored weights —
+        # as a DISTINCT copy (aliased buffers would be donated twice by the
+        # donating train step)
+        print("%s: checkpoint has no EMA stream; seeding EMA from the "
+              "restored params" % timestamp(), flush=True)
+        ema = jax.jit(lambda p: jax.tree.map(jnp.copy, p))(
+            restored["params"])
+    elif disk_ema and not want_ema:
+        print("%s: checkpoint has an EMA stream but --ema-decay is off; "
+              "dropping it" % timestamp(), flush=True)
+        ema = None
+    else:
+        ema = restored.get("ema_params")
     st = TrainState(
         step=jnp.asarray(restored["step"]),
         params=restored["params"],
         batch_stats=restored["batch_stats"],
-        opt_state=restored["opt_state"])
+        opt_state=restored["opt_state"],
+        ema_params=ema)
     return st, int(raw_ckpt["epoch"]), _read_loss_log(path)
 
 
-def restore_variables(path: str, params_template, batch_stats_template):
+def restore_variables(path: str, params_template, batch_stats_template,
+                      prefer_ema: bool = False):
     """Eval-time weight restore: (params, batch_stats), no optimizer
     (≡ ref train.py:191-193 when not training). Works regardless of the
     optimizer the checkpoint was trained with; the templates supply the
-    pytree structure only."""
+    pytree structure only. `prefer_ema` (--ema-eval) loads the EMA
+    weights when the checkpoint has them (error if it doesn't — silently
+    evaluating raw weights would misattribute the score)."""
     restored = _restore_raw(path)["state"]
+    weight_key = "params"
+    if prefer_ema:
+        if "ema_params" not in restored:
+            raise ValueError(
+                "--ema-eval: checkpoint %s has no EMA weights (trained "
+                "without --ema-decay)" % path)
+        weight_key = "ema_params"
     params = jax.tree.unflatten(jax.tree.structure(params_template),
-                                jax.tree.leaves(restored["params"]))
+                                jax.tree.leaves(restored[weight_key]))
     batch_stats = jax.tree.unflatten(
         jax.tree.structure(batch_stats_template),
         jax.tree.leaves(restored["batch_stats"]))

@@ -1,0 +1,150 @@
+"""EMA weights (--ema-decay / --ema-eval) — a capability the reference
+lacks: the jitted step keeps an exponential moving average of the params;
+eval can score with it."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_helmet_detection_tpu.config import Config
+from real_time_helmet_detection_tpu.data import synthetic_target_batch
+from real_time_helmet_detection_tpu.models import build_model
+from real_time_helmet_detection_tpu.optim import build_optimizer
+from real_time_helmet_detection_tpu.train import (create_train_state,
+                                                  load_checkpoint,
+                                                  make_train_step_body,
+                                                  restore_variables,
+                                                  save_checkpoint)
+from real_time_helmet_detection_tpu.ops.loss import LossLog
+
+IMSIZE = 64
+
+
+def _cfg(**kw):
+    base = dict(num_stack=1, hourglass_inch=16, num_cls=2, batch_size=2,
+                ema_decay=0.5)
+    base.update(kw)
+    return Config(**base)
+
+
+def _setup(cfg):
+    model = build_model(cfg)
+    tx = build_optimizer(cfg, 10)
+    state = create_train_state(model, cfg, jax.random.key(0), IMSIZE, tx)
+    step = jax.jit(make_train_step_body(model, tx, cfg))
+    batch = tuple(jnp.asarray(a) for a in synthetic_target_batch(2, IMSIZE))
+    return model, state, step, batch
+
+
+def test_ema_one_step_math():
+    """After one step from init (ema0 == params0):
+    ema1 = d*params0 + (1-d)*params1, elementwise."""
+    cfg = _cfg()
+    _, state, step, batch = _setup(cfg)
+    p0 = jax.device_get(state.params)
+    state1, _ = step(state, *batch)
+    p1 = jax.device_get(state1.params)
+    ema1 = jax.device_get(state1.ema_params)
+    want = jax.tree.map(lambda a, b: 0.5 * a + 0.5 * b, p0, p1)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+                 want, ema1)
+
+
+def test_ema_off_keeps_none():
+    cfg = _cfg(ema_decay=0.0)
+    _, state, step, batch = _setup(cfg)
+    assert state.ema_params is None
+    state, _ = step(state, *batch)
+    assert state.ema_params is None
+
+
+def test_ema_checkpoint_roundtrip_and_ema_eval(tmp_path):
+    cfg = _cfg()
+    model, state, step, batch = _setup(cfg)
+    state, _ = step(state, *batch)
+    state, _ = step(state, *batch)
+    path = save_checkpoint(str(tmp_path), 0, state, LossLog())
+
+    # training resume restores the EMA stream
+    tx = build_optimizer(cfg, 10)
+    template = create_train_state(model, cfg, jax.random.key(1), IMSIZE, tx)
+    restored, epoch, _ = load_checkpoint(path, template)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        jax.device_get(a), jax.device_get(b)),
+        restored.ema_params, state.ema_params)
+
+    # --ema-eval loads the EMA weights (not the raw ones)
+    params, _ = restore_variables(path, template.params,
+                                  template.batch_stats, prefer_ema=True)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        jax.device_get(a), jax.device_get(b)), params, state.ema_params)
+    raw, _ = restore_variables(path, template.params, template.batch_stats)
+    leaves_ema = jax.tree.leaves(params)
+    leaves_raw = jax.tree.leaves(raw)
+    assert any(not np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(leaves_ema, leaves_raw))
+
+
+def test_ema_eval_errors_without_ema_checkpoint(tmp_path):
+    cfg = _cfg(ema_decay=0.0)
+    model, state, step, batch = _setup(cfg)
+    path = save_checkpoint(str(tmp_path), 0, state, LossLog())
+    with pytest.raises(ValueError, match="no EMA weights"):
+        restore_variables(path, state.params, state.batch_stats,
+                          prefer_ema=True)
+
+
+def test_ema_updates_on_device_augment_path():
+    """The fused device-augment step must advance the EMA stream too — a
+    frozen EMA would silently report init-weight mAP under --ema-eval."""
+    from real_time_helmet_detection_tpu.parallel import make_mesh
+    from real_time_helmet_detection_tpu.train import make_device_train_step
+
+    cfg = _cfg(device_augment=True, multiscale=[64, 64, 64])
+    model = build_model(cfg)
+    tx = build_optimizer(cfg, 10)
+    state = create_train_state(model, cfg, jax.random.key(0), IMSIZE, tx)
+    mesh = make_mesh(1)
+    step = make_device_train_step(model, tx, cfg, mesh, target=IMSIZE)
+    images = jnp.zeros((2, IMSIZE, IMSIZE, 3), jnp.uint8)
+    boxes = jnp.zeros((2, cfg.max_boxes, 4), jnp.float32)
+    labels = jnp.zeros((2, cfg.max_boxes), jnp.int32)
+    valid = jnp.zeros((2, cfg.max_boxes), bool)
+    p0 = jax.device_get(state.params)
+    state, _ = step(state, jax.random.key(1), jnp.int32(0), images, boxes,
+                    labels, valid)
+    p1 = jax.device_get(state.params)
+    ema1 = jax.device_get(state.ema_params)
+    want = jax.tree.map(lambda a, b: 0.5 * a + 0.5 * b, p0, p1)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+                 want, ema1)
+
+
+def test_resume_across_ema_mismatch(tmp_path):
+    """Resuming a pre-EMA checkpoint with --ema-decay seeds the stream
+    from the restored weights; resuming an EMA checkpoint without
+    --ema-decay drops it — neither direction crashes."""
+    cfg_off = _cfg(ema_decay=0.0)
+    model, state_off, step, batch = _setup(cfg_off)
+    state_off, _ = step(state_off, *batch)
+    path_off = save_checkpoint(str(tmp_path / "off"), 0, state_off,
+                               LossLog())
+
+    cfg_on = _cfg()
+    tx = build_optimizer(cfg_on, 10)
+    template_on = create_train_state(model, cfg_on, jax.random.key(1),
+                                     IMSIZE, tx)
+    restored, _, _ = load_checkpoint(path_off, template_on)
+    # EMA seeded from the restored raw weights
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        jax.device_get(a), jax.device_get(b)),
+        restored.ema_params, restored.params)
+
+    _, state_on, step_on, batch = _setup(cfg_on)
+    state_on, _ = step_on(state_on, *batch)
+    path_on = save_checkpoint(str(tmp_path / "on"), 0, state_on, LossLog())
+    template_off = create_train_state(model, cfg_off, jax.random.key(2),
+                                      IMSIZE, build_optimizer(cfg_off, 10))
+    restored2, _, _ = load_checkpoint(path_on, template_off)
+    assert restored2.ema_params is None
