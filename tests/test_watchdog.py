@@ -1,0 +1,61 @@
+"""HangWatchdog unit tests (failure detection — SURVEY.md §5: the
+reference has none). The watchdog's contract: warn once when no beat
+arrives for warn_seconds, stay silent while paused (checkpoint saves can
+legitimately take minutes), and re-arm after a beat."""
+
+import time
+
+from real_time_helmet_detection_tpu.train import HangWatchdog
+
+
+def _wait_for(pred, timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+def test_watchdog_warns_on_stall(capsys):
+    wd = HangWatchdog(0.3, where="test")
+    try:
+        assert _wait_for(lambda: wd._warned)
+    finally:
+        wd.stop()
+    out = capsys.readouterr().out
+    assert "WATCHDOG: no test progress" in out
+    assert "last: start" in out
+
+
+def test_watchdog_beat_prevents_warning(capsys):
+    wd = HangWatchdog(0.6, where="test")
+    try:
+        for _ in range(8):
+            wd.beat("step")
+            time.sleep(0.15)
+        assert not wd._warned
+    finally:
+        wd.stop()
+    assert "WATCHDOG" not in capsys.readouterr().out
+
+
+def test_watchdog_pause_suppresses_then_rearms(capsys):
+    wd = HangWatchdog(0.3, where="test")
+    try:
+        wd.pause("checkpoint")
+        time.sleep(1.0)
+        assert not wd._warned  # paused: stall not reported
+        wd.resume("ckpt done")  # resume beats, then a fresh stall warns
+        assert _wait_for(lambda: wd._warned)
+    finally:
+        wd.stop()
+    out = capsys.readouterr().out
+    assert "last: ckpt done" in out
+
+
+def test_watchdog_disabled_at_zero():
+    wd = HangWatchdog(0)
+    assert wd._thread is None
+    wd.beat("x")
+    wd.stop()
