@@ -70,9 +70,56 @@ def log(msg: str) -> None:
     print("[bench] %s" % msg, file=sys.stderr, flush=True)
 
 
-def acquire_backend(retries: int = 3, backoff_s: float = 15.0):
+def _reexec_cpu():
+    """Re-exec the CURRENT script (argv[0], not this module — callers like
+    scripts/tpu_sweep.py import these helpers) with --cpu appended."""
+    os.execv(sys.executable, [sys.executable, os.path.abspath(sys.argv[0]),
+                              "--cpu"] + sys.argv[1:])
+
+
+def acquire_backend(retries: int = 3, backoff_s: float = 15.0,
+                    allow_cpu_fallback: bool = True):
     """Initialize the JAX backend with retry/backoff; returns (jax, devices)
-    or re-execs onto CPU as a last resort."""
+    or (when `allow_cpu_fallback`) re-execs argv[0] onto CPU as a last
+    resort — bench.py wants a clearly-labeled CPU JSON line over no line;
+    scripts that must not silently produce CPU numbers pass False and get
+    SystemExit instead.
+
+    The default backend is probed in a SUBPROCESS with a hard timeout
+    first: a wedged device claim makes in-process backend init HANG for
+    up to ~25 min per attempt (observed r2), which would stall the whole
+    run. Trade-offs, accepted deliberately: a healthy run pays one extra
+    backend init (~20 s); killing a timed-out probe can prolong an
+    already-wedged claim; and a chip merely BUSY in another process reads
+    as down — in a one-process-per-chip environment the bench could not
+    have run anyway, and an honest platform=cpu label beats a driver
+    timeout with no output at all."""
+    import subprocess
+    if "--cpu" not in sys.argv:
+        probe_ok, err = False, "?"
+        for attempt in range(retries):
+            try:
+                probe = subprocess.run(
+                    [sys.executable, "-c",
+                     "import jax; jax.devices(); print('ok')"],
+                    capture_output=True, text=True, timeout=240)
+                if probe.returncode == 0:
+                    probe_ok = True
+                    break
+                err = (probe.stderr.strip().splitlines() or ["?"])[-1][:200]
+                log("backend probe attempt %d/%d failed: %s"
+                    % (attempt + 1, retries, err))
+                time.sleep(backoff_s * (attempt + 1))
+            except subprocess.TimeoutExpired:
+                # a hang will not resolve on retry within a useful budget
+                err = "probe timed out (240s): claim wedged or service down"
+                log("backend %s" % err)
+                break
+        if not probe_ok:
+            if allow_cpu_fallback:
+                log("re-exec on CPU (numbers will be labeled platform=cpu)")
+                _reexec_cpu()
+            raise SystemExit("TPU backend unavailable: %s" % err)
     import jax
     if "--cpu" in sys.argv:
         jax.config.update("jax_platforms", "cpu")
@@ -90,11 +137,10 @@ def acquire_backend(retries: int = 3, backoff_s: float = 15.0):
                 % (attempt + 1, retries, str(e).splitlines()[-1] if str(e)
                    else repr(e)))
             time.sleep(backoff_s * (attempt + 1))
-    if "--cpu" not in sys.argv:
+    if "--cpu" not in sys.argv and allow_cpu_fallback:
         log("TPU backend unavailable after %d attempts; re-exec on CPU "
             "(numbers will be labeled platform=cpu)" % retries)
-        os.execv(sys.executable, [sys.executable, os.path.abspath(__file__),
-                                  "--cpu"] + sys.argv[1:])
+        _reexec_cpu()
     raise SystemExit("no backend available: %r" % last)
 
 

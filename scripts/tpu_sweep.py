@@ -83,7 +83,10 @@ def main() -> None:
         if a == "--only" and i + 1 < len(sys.argv):
             only = set(sys.argv[i + 1].split(","))
 
-    jax, devs = acquire_backend()
+    # never silently fall back: a CPU-platform rerun would discard the
+    # merged TPU records (merge_prior drops other-platform priors)
+    jax, devs = acquire_backend(
+        allow_cpu_fallback="--cpu" in sys.argv)
     import jax.numpy as jnp
     from jax import lax
 
