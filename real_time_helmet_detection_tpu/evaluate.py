@@ -70,9 +70,22 @@ def evaluate(cfg: Config) -> Dict:
     from .metrics import compute_map, write_detection_txt
 
     model, variables = load_eval_state(cfg)
+    # Multi-device eval: shard the batch over a data mesh when the batch
+    # divides the device count (single-host; the reference's eval is
+    # single-GPU only, ref evaluate.py:16). Oversized meshes are trimmed
+    # to the batch-divisible prefix rather than skipping DP entirely.
+    mesh = None
+    if jax.process_count() == 1:
+        from .parallel import fit_data_mesh, make_mesh
+        ndev = fit_data_mesh(cfg.batch_size, cfg.num_devices)
+        if ndev > 1:
+            mesh = make_mesh(ndev)
+            print("%s: eval sharded over %d devices"
+                  % (timestamp(), ndev), flush=True)
     # raw wire: images ship as uint8 canvases and are normalized on-device
     # inside the jitted predict program (see make_predict_fn)
-    predict = make_predict_fn(model, cfg, normalize=cfg.pretrained)
+    predict = make_predict_fn(model, cfg, normalize=cfg.pretrained,
+                              mesh=mesh)
 
     dataset, augmentor = load_dataset(cfg)
     loader = BatchLoader(dataset, augmentor, batch_size=cfg.batch_size,
@@ -138,7 +151,10 @@ def evaluate(cfg: Config) -> Dict:
             pad = cfg.batch_size - images.shape[0]
             images = np.concatenate(
                 [images, np.zeros((pad,) + images.shape[1:], images.dtype)])
-        dets_dev = predict(variables, jnp.asarray(images))  # async dispatch
+        # numpy goes straight to the jitted fn: pjit performs the (sharded,
+        # in the meshed case) H2D itself — an explicit jnp.asarray would
+        # commit the whole batch to device 0 first and re-distribute
+        dets_dev = predict(variables, images)  # async dispatch
         meters["dispatch"].update(time.time() - t0)
         if pending is not None:
             t0 = time.time()

@@ -9,6 +9,7 @@ jit instead of gradient-hook all-reduce.
 from .mesh import (
     batch_sharding,
     init_distributed,
+    fit_data_mesh,
     make_mesh,
     replicated,
     shard_batch,
@@ -17,6 +18,7 @@ from .mesh import (
 __all__ = [
     "batch_sharding",
     "init_distributed",
+    "fit_data_mesh",
     "make_mesh",
     "replicated",
     "shard_batch",

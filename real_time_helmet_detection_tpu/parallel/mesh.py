@@ -62,6 +62,25 @@ def make_mesh(num_devices: int = 0, spatial: int = 1,
     return Mesh(arr, (DATA_AXIS, SPATIAL_AXIS))
 
 
+def fit_data_mesh(batch_size: int, num_devices: int = 0,
+                  spatial: int = 1) -> int:
+    """Single-host mesh sizing shared by train and eval: clamp the request
+    to the VISIBLE device count (make_mesh would silently trim an
+    oversized request, then the sharding constraint would crash on the
+    first call), then shrink the data axis to the largest size that
+    divides `batch_size` (≡ the reference's per-GPU batch split,
+    ref train.py:38 — but without its silent truncation). Returns the
+    total device count to build the mesh with (data * spatial, >= spatial).
+    """
+    ndev = len(jax.devices())
+    if num_devices:
+        ndev = min(num_devices, ndev)
+    data = max(1, ndev // spatial)
+    while batch_size % data:
+        data -= 1
+    return data * spatial
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     """Fully-replicated sharding (params, opt state, scalars)."""
     return NamedSharding(mesh, P())

@@ -28,7 +28,8 @@ from .ops.nms import nms_mask, soft_nms_mask
 from .ops.pallas import fused_peak_scores
 
 
-def make_predict_fn(model, cfg, normalize: str | None = None) -> Callable:
+def make_predict_fn(model, cfg, normalize: str | None = None,
+                    mesh=None) -> Callable:
     """Build `predict(variables, images) -> Detections` (batched, jitted).
 
     images: (B, H, W, 3) normalized float32 — or, when `normalize` names a
@@ -37,6 +38,11 @@ def make_predict_fn(model, cfg, normalize: str | None = None) -> Callable:
     driver uses the latter so images cross the host->device boundary as
     uint8 (4x less traffic, same bits: the host path merely casts the
     augmentor's uint8 canvases before normalizing).
+
+    `mesh`: optional `jax.sharding.Mesh` — the batch dim shards over its
+    "data" axis (variables replicated), so evaluation data-parallelizes
+    over every device. The reference's eval is single-GPU only
+    (ref evaluate.py:16); this is the multi-chip eval path.
 
     Returns `Detections` with leading batch dim and N = num_stack * topk
     entries per image; `valid` combines the conf threshold and the NMS
@@ -87,8 +93,7 @@ def make_predict_fn(model, cfg, normalize: str | None = None) -> Callable:
         keep = nms_mask(boxes, scores, valid, nms_th)
         return keep, scores
 
-    @jax.jit
-    def predict(variables, images: jax.Array) -> Detections:
+    def predict_impl(variables, images: jax.Array) -> Detections:
         if normalize is not None:
             images = (images.astype(jnp.float32) / 255.0 - norm_mean) \
                 / norm_std
@@ -103,4 +108,13 @@ def make_predict_fn(model, cfg, normalize: str | None = None) -> Callable:
         return Detections(boxes=boxes, classes=classes, scores=scores,
                           valid=keep & valid)
 
-    return predict
+    if mesh is None:
+        return jax.jit(predict_impl)
+    from .parallel import batch_sharding, replicated
+    out_sh = Detections(boxes=batch_sharding(mesh, 3),
+                        classes=batch_sharding(mesh, 2),
+                        scores=batch_sharding(mesh, 2),
+                        valid=batch_sharding(mesh, 2))
+    return jax.jit(predict_impl,
+                   in_shardings=(replicated(mesh), batch_sharding(mesh, 4)),
+                   out_shardings=out_sh)

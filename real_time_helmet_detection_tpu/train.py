@@ -837,12 +837,10 @@ def train(cfg: Config) -> TrainState:
                 "data mesh axis %d (devices %d / spatial %d)"
                 % (cfg.batch_size, data, ndev, cfg.spatial))
     else:
-        # Single-host: use the largest data-axis size that divides the
-        # global batch (≡ the reference's per-GPU batch split,
-        # ref train.py:38 — but without its silent truncation).
-        while cfg.batch_size % data:
-            data -= 1
-        ndev = data * cfg.spatial
+        # Single-host: clamp + largest batch-dividing data axis (shared
+        # helper with the eval driver's mesh sizing)
+        from .parallel import fit_data_mesh
+        ndev = fit_data_mesh(cfg.batch_size, cfg.num_devices, cfg.spatial)
     mesh = make_mesh(ndev, spatial=cfg.spatial)
     is_chief = jax.process_index() == 0
 

@@ -178,3 +178,22 @@ def test_raw_wire_predict_matches_normalized():
     np.testing.assert_allclose(d_raw.scores, d_host.scores, atol=1e-5)
     np.testing.assert_allclose(d_raw.boxes, d_host.boxes, atol=1e-3)
     np.testing.assert_array_equal(d_raw.classes, d_host.classes)
+
+
+def test_mesh_parallel_predict_matches_single_device():
+    """Data-parallel eval (batch sharded over the 8-device mesh) must be
+    bit-identical to the unmeshed predict — the multi-chip eval path the
+    reference lacks (its eval is single-GPU, ref evaluate.py:16)."""
+    from real_time_helmet_detection_tpu.parallel import make_mesh
+
+    cfg = tiny_cfg(batch_size=8)
+    model = build_model(cfg)
+    imgs = jnp.asarray(
+        np.random.default_rng(3).normal(size=(8, 64, 64, 3))
+        .astype(np.float32))
+    variables = model.init(jax.random.key(0), imgs, train=False)
+    single = jax.device_get(make_predict_fn(model, cfg)(variables, imgs))
+    meshed = jax.device_get(
+        make_predict_fn(model, cfg, mesh=make_mesh(8))(variables, imgs))
+    for a, b in zip(single, meshed):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

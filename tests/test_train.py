@@ -469,3 +469,18 @@ def test_train_driver_async_ckpt(tmp_path):
         d = os.path.join(save, "check_point_%d" % e)
         assert os.path.isdir(d)
         assert os.path.exists(os.path.join(d, "loss_log.json"))
+
+
+def test_fit_data_mesh_sizing():
+    """Shared train/eval mesh sizing: clamp to visible devices, trim the
+    data axis to divide the batch, respect the spatial factor."""
+    from real_time_helmet_detection_tpu.parallel import fit_data_mesh
+    ndev = len(jax.devices())  # 8 virtual CPU devices under conftest
+    assert fit_data_mesh(8) == ndev
+    assert fit_data_mesh(6) == 6          # largest divisor of 6 <= 8
+    assert fit_data_mesh(7) == 7
+    assert fit_data_mesh(1) == 1
+    assert fit_data_mesh(8, num_devices=4) == 4
+    assert fit_data_mesh(8, num_devices=100) == ndev  # clamped to visible
+    assert fit_data_mesh(8, spatial=2) == 8           # (data=4, spatial=2)
+    assert fit_data_mesh(3, spatial=2) == 6           # data trims 4->3
