@@ -75,7 +75,11 @@ def fit_data_mesh(batch_size: int, num_devices: int = 0,
     ndev = len(jax.devices())
     if num_devices:
         ndev = min(num_devices, ndev)
-    data = max(1, ndev // spatial)
+    if ndev < spatial or ndev % spatial:
+        raise ValueError(
+            "spatial=%d must divide the usable device count %d"
+            % (spatial, ndev))
+    data = ndev // spatial
     while batch_size % data:
         data -= 1
     return data * spatial

@@ -484,3 +484,11 @@ def test_fit_data_mesh_sizing():
     assert fit_data_mesh(8, num_devices=100) == ndev  # clamped to visible
     assert fit_data_mesh(8, spatial=2) == 8           # (data=4, spatial=2)
     assert fit_data_mesh(3, spatial=2) == 6           # data trims 4->3
+
+
+def test_fit_data_mesh_rejects_unfit_spatial():
+    from real_time_helmet_detection_tpu.parallel import fit_data_mesh
+    with pytest.raises(ValueError, match="spatial"):
+        fit_data_mesh(8, num_devices=1, spatial=2)  # 1 usable < spatial
+    with pytest.raises(ValueError, match="spatial"):
+        fit_data_mesh(8, spatial=3)  # 3 does not divide 8 visible
