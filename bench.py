@@ -95,7 +95,13 @@ def acquire_backend(retries: int = 3, backoff_s: float = 15.0,
     have run anyway, and an honest platform=cpu label beats a driver
     timeout with no output at all."""
     import subprocess
-    if "--cpu" not in sys.argv:
+    if os.environ.get("BENCH_SKIP_PROBE"):
+        # Driver/waiter contexts that ALREADY established backend health
+        # (the single-claim-waiter pattern, CLAUDE.md) skip the probe: its
+        # timeout-kill could re-wedge an already-wedged claim, and a healthy
+        # chain shouldn't pay an extra ~20 s backend init per job.
+        pass
+    elif "--cpu" not in sys.argv:
         probe_ok, err = False, "?"
         for attempt in range(retries):
             try:

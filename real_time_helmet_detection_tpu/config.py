@@ -18,10 +18,11 @@ augmentation, loss, network, optimizer, logging), re-designed TPU-first:
   and `--num-devices` replaces `--gpu-no` (device *count* on the mesh,
   not CUDA ids).
 
-Dead reference flags are kept for CLI compatibility and documented as such:
-`--pool-size` (never read by the reference either, ref config.py:58),
-`--optim` (reference hard-codes Adam, ref optim.py:4 — here it actually
-selects the optax optimizer, an upgrade).
+Reference flags that were dead upstream are LIVE here (upgrades, each
+tested): `--pool-size` (parsed but never read by the reference, ref
+config.py:58 — here it is threaded through `predict`'s peak test, both the
+XLA and Pallas paths), `--optim` (reference hard-codes Adam, ref
+optim.py:4 — here it actually selects the optax optimizer).
 """
 
 from __future__ import annotations

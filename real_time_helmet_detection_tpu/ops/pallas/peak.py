@@ -35,38 +35,53 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG = -1e30  # python scalar: a jnp constant would be captured by the kernel
 
 
-def peak_scores_reference(logits: jax.Array) -> jax.Array:
+def peak_scores_reference(logits: jax.Array, pool_size: int = 3) -> jax.Array:
     """XLA reference: masked sigmoid peak scores.
 
     logits: (H, W, C) raw heatmap logits. Returns (H, W, C) where local
-    maxima of the *sigmoid* map (3x3, ties count) carry their sigmoid score
-    and all else is 0 — bit-identical to the production decode path
-    (`jnp.where(peak_mask(sigmoid(x)), sigmoid(x), 0)`).
+    maxima of the *sigmoid* map (pool_size x pool_size, ties count) carry
+    their sigmoid score and all else is 0 — bit-identical to the production
+    decode path (`jnp.where(peak_mask(sigmoid(x)), sigmoid(x), 0)`).
     """
     from ..decode import peak_mask
     heat = jax.nn.sigmoid(logits)
-    return jnp.where(peak_mask(heat), heat, 0.0)
+    return jnp.where(peak_mask(heat, pool_size), heat, 0.0)
 
 
-def _peak_kernel(x_ref, out_ref):
-    """One class channel: (1, H, W) logits block -> masked sigmoid scores."""
+def _shifted_max(x: jax.Array, axis: int, p: int) -> jax.Array:
+    """(2p+1)-tap running max along `axis` with edge padding of -inf —
+    2p VPU `maximum`s instead of a (2p+1)-tap reduce_window."""
+    out = x
+    for s in range(1, p + 1):
+        pad = jnp.full(tuple(s if a == axis else d
+                             for a, d in enumerate(x.shape)), _NEG)
+        fwd = jnp.concatenate(
+            [pad, jax.lax.slice_in_dim(x, 0, x.shape[axis] - s, axis=axis)],
+            axis=axis)
+        bwd = jnp.concatenate(
+            [jax.lax.slice_in_dim(x, s, x.shape[axis], axis=axis), pad],
+            axis=axis)
+        out = jnp.maximum(out, jnp.maximum(fwd, bwd))
+    return out
+
+
+def _peak_kernel(x_ref, out_ref, *, p: int):
+    """One class channel: (1, H, W) logits block -> masked sigmoid scores.
+
+    The (2p+1)^2 window max is built separably: a horizontal (2p+1)-max
+    followed by a vertical (2p+1)-max of it — 4p VPU `maximum`s on
+    VMEM-resident data instead of a (2p+1)^2-tap window."""
     x = jax.nn.sigmoid(x_ref[0])  # (H, W); peak test in sigmoid space
-    # horizontal 3-max
-    left = jnp.concatenate([jnp.full((x.shape[0], 1), _NEG), x[:, :-1]], axis=1)
-    right = jnp.concatenate([x[:, 1:], jnp.full((x.shape[0], 1), _NEG)], axis=1)
-    h3 = jnp.maximum(jnp.maximum(left, x), right)
-    # vertical 3-max of the horizontal max = full 3x3 window max
-    up = jnp.concatenate([jnp.full((1, x.shape[1]), _NEG), h3[:-1, :]], axis=0)
-    down = jnp.concatenate([h3[1:, :], jnp.full((1, x.shape[1]), _NEG)], axis=0)
-    pooled = jnp.maximum(jnp.maximum(up, h3), down)
+    pooled = _shifted_max(_shifted_max(x, 1, p), 0, p)
     out_ref[0] = jnp.where(pooled == x, x, 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _fused_chw(logits_chw: jax.Array, interpret: bool = False) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("interpret", "pool_size"))
+def _fused_chw(logits_chw: jax.Array, interpret: bool = False,
+               pool_size: int = 3) -> jax.Array:
     c, h, w = logits_chw.shape
     return pl.pallas_call(
-        _peak_kernel,
+        functools.partial(_peak_kernel, p=(pool_size - 1) // 2),
         grid=(c,),
         in_specs=[pl.BlockSpec((1, h, w), lambda i: (i, 0, 0),
                                memory_space=pltpu.VMEM)],
@@ -77,13 +92,20 @@ def _fused_chw(logits_chw: jax.Array, interpret: bool = False) -> jax.Array:
     )(logits_chw.astype(jnp.float32))
 
 
-def fused_peak_scores(logits: jax.Array, interpret: bool | None = None) -> jax.Array:
+def fused_peak_scores(logits: jax.Array, interpret: bool | None = None,
+                      pool_size: int = 3) -> jax.Array:
     """Pallas-fused peak scores, channels-last in/out.
 
     logits: (H, W, C) raw heatmap logits -> (H, W, C) masked sigmoid scores.
     `interpret=None` auto-selects interpret mode off-TPU (testability).
+    `pool_size` is the (odd) peak-test window; the separable-max kernel
+    generalizes to any size (ref transform.py:76-79 parses `--pool-size`
+    but hard-codes 3; here the flag is honored end to end).
     """
+    if pool_size % 2 != 1 or pool_size < 1:
+        raise ValueError("pool_size must be odd and >= 1, got %d" % pool_size)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     chw = jnp.transpose(logits, (2, 0, 1))
-    return jnp.transpose(_fused_chw(chw, interpret=interpret), (1, 2, 0))
+    return jnp.transpose(_fused_chw(chw, interpret=interpret,
+                                    pool_size=pool_size), (1, 2, 0))

@@ -57,6 +57,11 @@ def make_predict_fn(model, cfg, normalize: str | None = None,
     conf_th = float(cfg.conf_th)
     nms_th = float(cfg.nms_th)
     scale_factor = int(cfg.scale_factor)
+    pool_size = int(getattr(cfg, "pool_size", 3))
+    if pool_size % 2 != 1 or pool_size < 1:
+        # validate where the flag enters the pipeline: the XLA reduce_window
+        # path would otherwise die with a cryptic shape error inside jit
+        raise ValueError("pool_size must be odd and >= 1, got %d" % pool_size)
     normalized = bool(cfg.normalized_coord)
     use_soft = cfg.nms == "soft-nms"
     if cfg.nms not in ("nms", "soft-nms"):
@@ -75,18 +80,25 @@ def make_predict_fn(model, cfg, normalize: str | None = None,
             offset = jax.nn.sigmoid(offset)
             wh = jax.nn.sigmoid(wh)
         if use_pallas:
-            peaks = fused_peak_scores(o[..., :num_cls])
+            peaks = fused_peak_scores(o[..., :num_cls], pool_size=pool_size)
             return decode_peak_scores(peaks, offset, wh,
                                       scale_factor=scale_factor, topk=topk,
                                       conf_th=conf_th, normalized=normalized)
         heat = jax.nn.sigmoid(o[..., :num_cls])
         return decode_heatmap(heat, offset, wh, scale_factor=scale_factor,
                               topk=topk, conf_th=conf_th,
-                              normalized=normalized)
+                              normalized=normalized, pool_size=pool_size)
 
     def suppress(boxes, scores, valid):
         """Cross-stack class-agnostic NMS (ref evaluate.py:155-163, 167-180)."""
         if use_soft:
+            # score_th = conf_th matches the reference CALL SITE, which
+            # overrides soft_nms_pytorch's 0.001 default with the --conf-th
+            # flag: `soft_nms_pytorch(boxes, scores, thresh=self.conf_th)`
+            # (ref evaluate.py:177 vs the :184 signature default). With eval
+            # defaults (conf_th 0.0) the reference drops nothing either;
+            # tests/test_nms.py pins the full decay recurrence against a
+            # sequential oracle port of ref evaluate.py:184-243.
             keep, new_scores = soft_nms_mask(boxes, scores, valid,
                                              score_th=conf_th)
             return keep, new_scores

@@ -53,7 +53,7 @@ def memory_analysis_of(compiled):
 
 
 OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "artifacts", "r02", "sweep.json")
+    os.path.abspath(__file__))), "artifacts", "r03", "sweep.json")
 
 # section name (CLI --only vocabulary) -> results key
 SECTION_KEYS = {"inference": "inference_batch_sweep",
@@ -65,12 +65,18 @@ def merge_prior(results: dict, prior: dict, only: set) -> dict:
     """Carry prior-run records into `results` for sections NOT being rerun.
 
     A section in `only` starts empty (its records would duplicate on
-    re-append); prior results from a different platform are discarded
-    entirely. Mutates and returns `results`; no I/O, so
-    tests/test_bench_helpers.py can pin the semantics directly.
+    re-append). Platform-mismatched priors must never reach here — the
+    caller redirects the output to a platform-suffixed file instead (a
+    `--cpu --only X` rerun must not rewrite a merged TPU artifact with
+    emptied TPU sections, round-2 advisor finding). Mutates and returns
+    `results`; no I/O, so tests/test_bench_helpers.py can pin the
+    semantics directly.
     """
     if prior.get("platform") != results.get("platform"):
-        return results
+        raise ValueError(
+            "platform mismatch: prior %r vs current %r — write to a "
+            "platform-suffixed file instead of merging"
+            % (prior.get("platform"), results.get("platform")))
     for sec, k in SECTION_KEYS.items():
         if sec not in only:
             results[k] = prior.get(k, results[k])
@@ -82,6 +88,12 @@ def main() -> None:
     for i, a in enumerate(sys.argv):
         if a == "--only" and i + 1 < len(sys.argv):
             only = set(sys.argv[i + 1].split(","))
+            unknown = only - set(SECTION_KEYS)
+            if unknown:
+                # a typo would silently run nothing while still rewriting
+                # the output file (round-2 advisor finding)
+                raise SystemExit("unknown --only section(s) %s; valid: %s"
+                                 % (sorted(unknown), sorted(SECTION_KEYS)))
 
     # never silently fall back: a CPU-platform rerun would discard the
     # merged TPU records (merge_prior drops other-platform priors)
@@ -119,13 +131,35 @@ def main() -> None:
         "inference_batch_sweep": [], "train_batch_sweep": [],
         "num_stack2": {}, "remat": [],
     }
-    if only and os.path.exists(OUT_PATH):
-        with open(OUT_PATH) as f:
-            prior = json.load(f)
+    def read_prior(path):
+        """Prior results at `path`, or None if absent/unreadable — a kill
+        mid-flush can truncate the JSON; the salvage rerun must proceed as
+        if no prior existed rather than crash before reaching the chip."""
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (json.JSONDecodeError, OSError) as e:
+            log("prior %s unreadable (%r); treating as absent" % (path, e))
+            return None
+
+    out_path = OUT_PATH
+    prior = read_prior(OUT_PATH)
+    if prior is not None and prior.get("platform") != platform:
+        # never clobber another platform's merged records: divert this
+        # run to a platform-suffixed file (round-2 advisor finding) —
+        # and resume from THAT file's own records so --only keeps working
+        out_path = OUT_PATH.replace(".json", ".%s.json" % platform)
+        log("prior %s is platform=%r; writing to %s instead"
+            % (OUT_PATH, prior.get("platform"), out_path))
+        prior = read_prior(out_path)
+    if prior is not None and only:
         results = merge_prior(results, prior, only)
 
     def flush():
-        with open(OUT_PATH, "w") as f:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
             json.dump(results, f, indent=1)
 
     def want(section):

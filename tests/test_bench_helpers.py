@@ -72,14 +72,19 @@ def test_sweep_merge_prior_keeps_only_unrerun_sections():
     assert out["num_stack2"] == prior["num_stack2"]
 
 
-def test_sweep_merge_prior_discards_other_platform():
+def test_sweep_merge_prior_rejects_other_platform():
+    # A platform-mismatched merge must be refused loudly: silently dropping
+    # the prior records let a `--cpu --only X` rerun clobber merged TPU data
+    # (round-2 advisor finding); main() diverts such runs to a
+    # platform-suffixed file instead of calling merge_prior at all.
+    import pytest
     sweep = _load_sweep()
     fresh = {"platform": "tpu", "inference_batch_sweep": [],
              "train_batch_sweep": [], "num_stack2": {}, "remat": []}
     prior = {"platform": "cpu",
              "inference_batch_sweep": [{"batch": 1, "img_per_sec": 9.0}]}
-    out = sweep.merge_prior(dict(fresh), prior, only={"train"})
-    assert out["inference_batch_sweep"] == []
+    with pytest.raises(ValueError, match="platform mismatch"):
+        sweep.merge_prior(dict(fresh), prior, only={"train"})
 
 
 def test_sweep_section_keys_cover_all_result_lists():

@@ -90,6 +90,94 @@ def test_soft_nms_kills_duplicates():
     assert np.asarray(keep).tolist() == [True, False]
 
 
+def _np_soft_nms(boxes, scores, sigma=0.5, thresh=0.001):
+    """Sequential oracle mirroring the reference's swap-based Soft-NMS
+    (ref evaluate.py:184-243): at round i the max-scoring remaining box is
+    swapped into slot i, then every later box is decayed by
+    exp(-iou^2/sigma) using the +1 inclusive-coordinate IoU; survivors are
+    final score > thresh. Returns (keep index set, final scores by ORIGINAL
+    index)."""
+    boxes = np.asarray(boxes, np.float64).copy()
+    scores = np.asarray(scores, np.float64).copy()
+    n = len(boxes)
+    idx = np.arange(n)
+    for i in range(n):
+        if i < n - 1:
+            m = i + 1 + int(np.argmax(scores[i + 1:]))
+            if scores[i] < scores[m]:
+                for arr in (boxes, scores, idx):
+                    arr[[i, m]] = arr[[m, i]]
+        rest = np.arange(i + 1, n)
+        if rest.size == 0:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+        inter = np.maximum(0.0, xx2 - xx1 + 1) * np.maximum(0.0, yy2 - yy1 + 1)
+        area_i = (boxes[i, 2] - boxes[i, 0] + 1) * (boxes[i, 3] - boxes[i, 1] + 1)
+        area_r = (boxes[rest, 2] - boxes[rest, 0] + 1) \
+            * (boxes[rest, 3] - boxes[rest, 1] + 1)
+        iou = inter / (area_i + area_r - inter)
+        scores[rest] *= np.exp(-(iou ** 2) / sigma)
+    final = np.empty(n)
+    final[idx] = scores
+    return set(idx[scores > thresh].tolist()), final
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_soft_nms_matches_reference_oracle(seed):
+    """The fixed-iteration masked formulation must reproduce the reference's
+    sequential swap-based loop: same survivor set AND same decayed scores
+    (round-2 verdict missing #5 — the hard-NMS path had an oracle, the soft
+    path did not)."""
+    rng = np.random.RandomState(seed)
+    n = 40
+    # clustered boxes so overlaps (and multi-step decay chains) are common
+    centers = rng.uniform(20, 80, (8, 2))
+    xy = centers[rng.randint(0, 8, n)] + rng.uniform(-8, 8, (n, 2))
+    wh = rng.uniform(10, 30, (n, 2))
+    boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+    scores = rng.uniform(0.05, 1.0, n).astype(np.float32)
+
+    thresh = 0.3  # a floor that actually drops some decayed boxes
+    ref_keep, ref_scores = _np_soft_nms(boxes, scores, sigma=0.5,
+                                        thresh=thresh)
+    keep, new_scores = soft_nms_mask(jnp.asarray(boxes), jnp.asarray(scores),
+                                     jnp.ones(n, bool), sigma=0.5,
+                                     score_th=thresh)
+    assert set(np.nonzero(np.asarray(keep))[0].tolist()) == ref_keep
+    np.testing.assert_allclose(np.asarray(new_scores), ref_scores,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_soft_nms_invalid_entries_ignored_vs_oracle():
+    """Masked entries must neither decay others nor be kept; the valid
+    subset must behave exactly as the oracle run on that subset alone."""
+    rng = np.random.RandomState(7)
+    n = 24
+    xy = rng.uniform(10, 60, (n, 2))
+    wh = rng.uniform(15, 40, (n, 2))
+    boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+    scores = rng.uniform(0.05, 1.0, n).astype(np.float32)
+    valid = rng.rand(n) < 0.6
+
+    ref_keep_sub, ref_scores_sub = _np_soft_nms(
+        boxes[valid], scores[valid], sigma=0.5, thresh=0.2)
+    sub_to_full = np.nonzero(valid)[0]
+    ref_keep = {int(sub_to_full[i]) for i in ref_keep_sub}
+
+    keep, new_scores = soft_nms_mask(jnp.asarray(boxes), jnp.asarray(scores),
+                                     jnp.asarray(valid), sigma=0.5,
+                                     score_th=0.2)
+    assert set(np.nonzero(np.asarray(keep))[0].tolist()) == ref_keep
+    np.testing.assert_allclose(np.asarray(new_scores)[valid], ref_scores_sub,
+                               rtol=1e-4, atol=1e-5)
+    # invalid entries keep their input scores (decay never touches them)
+    np.testing.assert_allclose(np.asarray(new_scores)[~valid],
+                               scores[~valid], rtol=1e-6)
+
+
 def test_nms_three_hundred_near_duplicates_keep_one():
     """The classic deployment probe: hundreds of near-identical boxes in,
     one survivor out."""

@@ -52,6 +52,33 @@ def test_fused_peak_saturated_plateau_matches_xla():
     assert got[2, 2, 0] == 1.0 and got[2, 3, 0] == 1.0  # both saturated ties
 
 
+@pytest.mark.parametrize("pool_size", [1, 5, 7])
+def test_fused_peak_pool_size_matches_xla_reference(pool_size):
+    """The separable-max kernel must honor --pool-size (round-2 verdict
+    weak #4: the flag was parsed but dead in production)."""
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.standard_normal((24, 24, 2)).astype(np.float32) * 3)
+    got = fused_peak_scores(logits, interpret=True, pool_size=pool_size)
+    want = peak_scores_reference(logits, pool_size=pool_size)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_fused_peak_pool_size_changes_peak_set():
+    # Two maxima 2 cells apart: both are 3x3 peaks, only the larger is a
+    # 5x5 peak.
+    logits = jnp.full((12, 12, 1), -5.0, jnp.float32)
+    logits = logits.at[5, 4, 0].set(2.0).at[5, 6, 0].set(3.0)
+    p3 = np.asarray(fused_peak_scores(logits, interpret=True, pool_size=3))
+    p5 = np.asarray(fused_peak_scores(logits, interpret=True, pool_size=5))
+    assert p3[5, 4, 0] > 0 and p3[5, 6, 0] > 0
+    assert p5[5, 4, 0] == 0.0 and p5[5, 6, 0] > 0
+
+
+def test_fused_peak_rejects_even_pool_size():
+    with pytest.raises(ValueError):
+        fused_peak_scores(jnp.zeros((8, 8, 1)), interpret=True, pool_size=4)
+
+
 def test_decode_consistent_with_fused_scores():
     """Running top-k on the fused scores reproduces decode_heatmap's
     peak/score selection."""
