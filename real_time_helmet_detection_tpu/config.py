@@ -156,9 +156,13 @@ class Config:
     # epoch 0 (device-augment paths): each bucket's first XLA compile
     # otherwise stalls a mid-epoch step 20-40s on a remote-TPU transport
     auto_resume: int = 0          # elastic recovery: on a transient backend
-    # failure, back off, restore the newest checkpoint in save-path and
-    # continue in-process, up to N times (0 disables; single-host only).
-    # The reference's only recovery is a manual restart (its train.py:190).
+    # failure, back off, probe the device, re-stage device-held state
+    # (RNG key, HBM cache if lost), restore the newest checkpoint in
+    # save-path and continue in-process, up to N times (0 disables;
+    # single-host only). Scope: TRANSPORT-transient failures — the PJRT
+    # client cannot be rebuilt in-process, so a dead backend aborts with
+    # advice to restart with --model-load. The reference's only recovery
+    # is a manual restart (its train.py:190).
     fault_inject: str = ""        # debug: "EPOCH:ITER" raises one synthetic
     # transient backend error at that step, to exercise --auto-resume
     save_path: str = "./WEIGHTS/"

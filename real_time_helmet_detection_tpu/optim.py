@@ -21,6 +21,8 @@ gamma 0.1), re-designed for step-based optax schedules:
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import optax
 
 
@@ -37,23 +39,86 @@ def make_lr_schedule(cfg, steps_per_epoch: int) -> optax.Schedule:
     return optax.piecewise_constant_schedule(cfg.lr, boundaries)
 
 
-def build_optimizer(cfg, steps_per_epoch: int) -> optax.GradientTransformation:
-    """Construct the optax transformation from config flags."""
-    updates_per_epoch = max(1, steps_per_epoch // max(1, cfg.sub_divisions))
-    schedule = make_lr_schedule(cfg, updates_per_epoch)
+def _base_optimizer(cfg, schedule) -> optax.GradientTransformation:
     name = cfg.optim.lower()
     if name == "adam":
-        tx = optax.adam(schedule)
-    elif name == "adamw":
-        tx = optax.adamw(schedule)
-    elif name == "sgd":
-        tx = optax.sgd(schedule, momentum=0.9)
-    else:
-        raise NotImplementedError("Not expected optimizer: %s" % cfg.optim)
+        return optax.adam(schedule)
+    if name == "adamw":
+        return optax.adamw(schedule)
+    if name == "sgd":
+        return optax.sgd(schedule, momentum=0.9)
+    raise NotImplementedError("Not expected optimizer: %s" % cfg.optim)
+
+
+def _updates_per_epoch(cfg, steps_per_epoch: int) -> int:
+    # ceil: the epoch-end flush (make_accum_flush) emits the partial
+    # window, so a k-trailing epoch still produces its last update —
+    # exactly the reference's per-epoch optimizer-step count
+    # (ref train.py:124: `... or (iteration == len(dataloader))`)
+    return max(1, -(-steps_per_epoch // max(1, cfg.sub_divisions)))
+
+
+def _inner_chain(cfg, steps_per_epoch: int) -> optax.GradientTransformation:
+    """The transformation MultiSteps wraps: scale(k) ∘ base optimizer.
+
+    The ONE definition shared by build_optimizer and make_accum_flush —
+    they must stay structurally identical or the flush's inner update
+    would not type-check against the training run's inner_opt_state.
+    MultiSteps emits the micro-grad mean; pre-scaling by k turns that into
+    the reference's summed gradient (ref train.py:128-136 accumulates
+    without dividing)."""
+    schedule = make_lr_schedule(cfg, _updates_per_epoch(cfg, steps_per_epoch))
+    return optax.chain(optax.scale(float(cfg.sub_divisions)),
+                       _base_optimizer(cfg, schedule))
+
+
+def build_optimizer(cfg, steps_per_epoch: int) -> optax.GradientTransformation:
+    """Construct the optax transformation from config flags."""
     if cfg.sub_divisions > 1:
-        # MultiSteps emits the micro-grad mean; pre-scaling the inner
-        # optimizer's input by k turns that into the reference's summed
-        # gradient (ref train.py:128-136 accumulates without dividing).
-        inner = optax.chain(optax.scale(float(cfg.sub_divisions)), tx)
-        tx = optax.MultiSteps(inner, every_k_schedule=cfg.sub_divisions)
-    return tx
+        return optax.MultiSteps(_inner_chain(cfg, steps_per_epoch),
+                                every_k_schedule=cfg.sub_divisions)
+    schedule = make_lr_schedule(cfg, _updates_per_epoch(cfg, steps_per_epoch))
+    return _base_optimizer(cfg, schedule)
+
+
+def make_accum_flush(cfg, steps_per_epoch: int):
+    """Epoch-end partial-accumulation flush, or None when k == 1.
+
+    The reference steps the optimizer every `sub_divisions` iterations OR
+    at the last iteration of the epoch (ref train.py:124-139), applying the
+    partial SUM of the trailing j < k micro-gradients; `optax.MultiSteps`
+    alone would silently carry that partial window into the next epoch.
+    Returns `flush(params, opt_state) -> (params, opt_state)`: when
+    `mini_step > 0` it applies the inner optimizer to the accumulated
+    partial sum and resets the window; a no-op otherwise. Jit-able; the
+    caller (train()) checks `mini_step` host-side so epochs whose length
+    divides k dispatch nothing."""
+    if cfg.sub_divisions <= 1:
+        return None
+    k = float(cfg.sub_divisions)
+    inner = _inner_chain(cfg, steps_per_epoch)
+
+    def flush(params, opt_state):
+        j = opt_state.mini_step
+        # acc_grads is the running MEAN of the j micro-grads; the inner
+        # chain multiplies by k, so pre-scaling by j/k feeds the inner
+        # optimizer the partial SUM — the reference's trailing update.
+        def apply(args):
+            params, opt_state = args
+            ratio = j.astype(jnp.float32) / k
+            grads = jax.tree.map(lambda g: g * ratio.astype(g.dtype),
+                                 opt_state.acc_grads)
+            updates, new_inner = inner.update(grads, opt_state.inner_opt_state,
+                                              params)
+            new_params = optax.apply_updates(params, updates)
+            new_opt = opt_state._replace(
+                mini_step=jnp.zeros_like(opt_state.mini_step),
+                gradient_step=opt_state.gradient_step + 1,
+                inner_opt_state=new_inner,
+                acc_grads=jax.tree.map(jnp.zeros_like, opt_state.acc_grads))
+            return new_params, new_opt
+
+        return jax.lax.cond(j > 0, apply, lambda args: args,
+                            (params, opt_state))
+
+    return flush

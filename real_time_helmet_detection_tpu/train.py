@@ -173,6 +173,34 @@ def make_scanned_train_fn(body, n: int):
     return train_n
 
 
+def make_state_accum_flush(cfg: Config, steps_per_epoch: int):
+    """TrainState-level epoch-end accumulation flush, or None when
+    --sub-divisions is 1.
+
+    Parity: the reference steps the optimizer at the LAST iteration of
+    every epoch even mid-accumulation-window (ref train.py:124-139);
+    optax.MultiSteps would otherwise carry the partial window into the
+    next epoch. The EMA stream advances with the flushed update exactly as
+    with any other optimizer step."""
+    from .optim import make_accum_flush
+    flush = make_accum_flush(cfg, steps_per_epoch)
+    if flush is None:
+        return None
+
+    @jax.jit
+    def run(state: TrainState) -> TrainState:
+        params, opt_state = flush(state.params, state.opt_state)
+        ema = state.ema_params
+        if cfg.ema_decay > 0 and ema is not None:
+            d = cfg.ema_decay
+            ema = jax.tree.map(lambda e, p: d * e + (1.0 - d) * p, ema,
+                               params)
+        return state.replace(params=params, opt_state=opt_state,
+                             ema_params=ema)
+
+    return run
+
+
 def make_train_step(model, tx, cfg: Config, mesh):
     """Build the jitted, mesh-partitioned train step.
 
@@ -717,11 +745,17 @@ class FaultInjector:
 
 
 # Status markers that identify a device/transport failure worth retrying
-# (vs a programming error, which must propagate). Matched against
-# XlaRuntimeError/RuntimeError messages.
-_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "INTERNAL",
-                      "Unable to initialize backend", "Socket closed",
-                      "connection")
+# (vs a programming error, which must propagate). XLA status-prefix form
+# ("UNAVAILABLE: ...") rather than bare substrings: a genuine programming
+# error whose message merely contains the word "connection" (e.g. a
+# data-loader connection-string bug) must NOT trigger restore-and-retry
+# (round-2 advisor finding). Matched against XlaRuntimeError/RuntimeError.
+_TRANSIENT_MARKERS = ("UNAVAILABLE:", "DEADLINE_EXCEEDED:",
+                      "Unable to initialize backend", "Socket closed")
+# INTERNAL is how the axon plugin surfaces tunnel deaths, but it is also
+# XLA's generic assertion bucket — require the XlaRuntimeError type (a
+# plain RuntimeError with "INTERNAL" in its text is not backend evidence).
+_TRANSIENT_MARKERS_XLA_ONLY = ("INTERNAL:",)
 
 
 def is_transient_backend_error(e: BaseException) -> bool:
@@ -731,7 +765,10 @@ def is_transient_backend_error(e: BaseException) -> bool:
     if type(e).__name__ not in ("XlaRuntimeError", "RuntimeError"):
         return False
     msg = str(e)
-    return any(m in msg for m in _TRANSIENT_MARKERS)
+    if any(m in msg for m in _TRANSIENT_MARKERS):
+        return True
+    return type(e).__name__ == "XlaRuntimeError" and \
+        any(m in msg for m in _TRANSIENT_MARKERS_XLA_ONLY)
 
 
 def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
@@ -929,6 +966,7 @@ def train(cfg: Config) -> TrainState:
     watchdog = HangWatchdog(cfg.hang_warn_seconds)
     writer = CheckpointWriter(async_save=cfg.async_ckpt)
     injector = FaultInjector(cfg.fault_inject)
+    epoch_flush = make_state_accum_flush(cfg, steps_per_epoch)
     resume_attempts = 0
     run_ckpts: list = []  # checkpoints written by THIS run, oldest first
     epoch = start_epoch
@@ -941,6 +979,13 @@ def train(cfg: Config) -> TrainState:
                     profile_this_epoch=(cfg.profile and epoch == start_epoch),
                     epoch_base_step=epoch * steps_per_epoch,
                     watchdog=watchdog, injector=injector)
+                if epoch_flush is not None and int(jax.device_get(
+                        state.opt_state.mini_step)):
+                    # partial accumulation window at epoch end: flush it
+                    # (one scalar fetch + one dispatch per epoch, only
+                    # when --sub-divisions > 1 and the epoch length does
+                    # not divide k)
+                    state = epoch_flush(state)
                 # every N epochs + always the final one (a full-state save
                 # costs a device_get of params+optimizer — seconds over a
                 # remote tunnel)
@@ -1002,6 +1047,44 @@ def train(cfg: Config) -> TrainState:
                          cfg.auto_resume, wait), flush=True)
                 watchdog.pause("auto-resume backoff")
                 time.sleep(wait)
+                # Re-stage device-resident context before restoring
+                # (round-2 advisor finding: retrying with dead buffers
+                # burns the whole attempt budget). Scope: in-process
+                # recovery targets TRANSPORT-transient failures — the PJRT
+                # client is cached per process and cannot be rebuilt here,
+                # so if even a fresh tiny op fails the backend itself is
+                # gone and the only recovery is a process restart with
+                # --model-load; propagate instead of spinning.
+                try:
+                    # device_get of the RESULT, not block_until_ready: on
+                    # the axon tunnel completion events resolve before
+                    # remote execution finishes (CLAUDE.md), so only a real
+                    # D2H fetch proves the backend executed anything
+                    float(jax.device_get(jnp.zeros(()) + 1.0))
+                except Exception as probe_err:  # noqa: BLE001
+                    raise RuntimeError(
+                        "auto-resume aborted: device probe failed after "
+                        "backoff (%s) — backend is dead, not transient; "
+                        "restart the process with --model-load"
+                        % str(probe_err).splitlines()[0][:200]) from e
+                # drop compiled executables (they may pin buffers from the
+                # failed step; they lazily re-JIT from the persistent
+                # compile cache) and rebuild the runner so the device-held
+                # RNG base key is re-staged
+                jax.clear_caches()
+                if cache is not None:
+                    try:  # HBM canvases survive a transport blip...
+                        int(jax.device_get(jnp.sum(cache.images[:1])))
+                    except Exception:  # noqa: BLE001 — ...but not a wedge
+                        print("%s: --cache-device HBM cache lost; "
+                              "re-staging dataset" % timestamp(), flush=True)
+                        cache = DeviceDatasetCache(
+                            dataset, augmentor, batch_size=cfg.batch_size,
+                            max_boxes=cfg.max_boxes, shuffle=True,
+                            drop_last=True, seed=cfg.random_seed,
+                            num_workers=cfg.num_workers, mesh=mesh)
+                        loader = cache
+                runner = make_step_runner(cfg, mesh, model, tx, cache=cache)
                 # only checkpoints written by THIS run are trusted: a
                 # reused save_path can hold a previous run's (possibly
                 # later-epoch) checkpoints, which would silently replace

@@ -49,7 +49,7 @@ OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
 
 # v5e HBM bandwidth (jax-ml scaling-book): ~819 GB/s.
 HBM_GBPS = {"v5e": 819e9, "v5 lite": 819e9, "v4": 1228e9, "v5p": 2765e9,
-            "v6e": 1640e9, "v6 lite": 1640e9}
+            "v6e": 1640e9, "v6 lite": 1640e9, "trillium": 1640e9}
 DEFAULT_HBM = 819e9
 
 

@@ -144,15 +144,21 @@ def main() -> None:
             log("prior %s unreadable (%r); treating as absent" % (path, e))
             return None
 
-    out_path = OUT_PATH
-    prior = read_prior(OUT_PATH)
+    # TPU owns the canonical filename UNCONDITIONALLY: a CPU smoke run on a
+    # fresh artifacts dir must not claim sweep.json first and shunt every
+    # later TPU sweep to a suffixed file (review finding on the r2 advisor
+    # fix, which only protected whichever platform wrote first)
+    out_path = OUT_PATH if platform == "tpu" else \
+        OUT_PATH.replace(".json", ".%s.json" % platform)
+    if out_path != OUT_PATH:
+        log("non-TPU run: writing to %s (canonical %s is TPU-only)"
+            % (out_path, OUT_PATH))
+    prior = read_prior(out_path)
     if prior is not None and prior.get("platform") != platform:
-        # never clobber another platform's merged records: divert this
-        # run to a platform-suffixed file (round-2 advisor finding) —
-        # and resume from THAT file's own records so --only keeps working
-        out_path = OUT_PATH.replace(".json", ".%s.json" % platform)
-        log("prior %s is platform=%r; writing to %s instead"
-            % (OUT_PATH, prior.get("platform"), out_path))
+        # e.g. a pre-r3 sweep.json written by a CPU fallback: step aside
+        out_path = out_path.replace(".json", ".%s.json" % platform)
+        log("prior is platform=%r; diverting to %s"
+            % (prior.get("platform"), out_path))
         prior = read_prior(out_path)
     if prior is not None and only:
         results = merge_prior(results, prior, only)

@@ -49,6 +49,25 @@ def test_transient_error_classifier():
     assert not is_transient_backend_error(ValueError("UNAVAILABLE"))
 
 
+def test_transient_error_classifier_requires_status_prefix():
+    """Bare substrings must not classify (round-2 advisor finding): a
+    programming error mentioning 'connection' or 'INTERNAL' in prose is not
+    backend evidence."""
+    assert not is_transient_backend_error(
+        RuntimeError("bad data-loader connection string: tcp://x"))
+    # INTERNAL needs the XLA status prefix AND the XlaRuntimeError type
+    assert not is_transient_backend_error(
+        RuntimeError("INTERNAL: assertion failed in user code"))
+
+    class XlaRuntimeError(RuntimeError):  # stand-in with the real type name
+        pass
+
+    assert is_transient_backend_error(
+        XlaRuntimeError("INTERNAL: stream did not block host until done"))
+    assert is_transient_backend_error(
+        XlaRuntimeError("UNAVAILABLE: TPU backend setup/compile error"))
+
+
 def test_fault_injector_rejects_malformed_spec():
     for bad in ("5", "1:2:3", "a:b"):
         with pytest.raises(ValueError):

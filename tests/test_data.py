@@ -207,3 +207,18 @@ def test_parser_skips_placeholder_objects():
     b, l = boxes_from_voc_dict(d)               # consumer skips placeholder
     assert b.tolist() == [[1.0, 2.0, 3.0, 4.0]]
     assert l.tolist() == [0]
+
+
+def test_parser_self_closed_filename_is_empty_string():
+    """A self-closed <filename/> parses to "" (the r2 parser rewrite's
+    convention); consumers must use `get("filename") or fallback` — a bare
+    .get default would accept the empty string as an image id (round-2
+    advisor finding, fixed in evaluate.py's consume)."""
+    import xml.etree.ElementTree as ET
+
+    from real_time_helmet_detection_tpu.data.voc import parse_voc_xml
+    d = parse_voc_xml(ET.fromstring(
+        "<annotation><filename/><size><width>4</width><height>4</height>"
+        "</size></annotation>"))
+    assert d["annotation"]["filename"] == ""
+    assert (d["annotation"].get("filename") or "000042") == "000042"

@@ -81,6 +81,15 @@ def test_predict_pool_size_threaded_end_to_end():
     assert all(s in s3 for s in s9)
 
 
+def test_evaluate_rejects_multihost(monkeypatch):
+    """Multi-host eval must fail loudly, not silently compute on one host's
+    devices (round-2 verdict weak #6)."""
+    from real_time_helmet_detection_tpu import evaluate as ev
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(ValueError, match="single-host"):
+        ev.evaluate(tiny_cfg(train_flag=False))
+
+
 def test_predict_rejects_unknown_nms():
     cfg = tiny_cfg(nms="magic")
     model = build_model(cfg)

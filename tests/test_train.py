@@ -177,6 +177,54 @@ def test_grad_accumulation_matches_reference_sum():
         jax.device_get(jax.tree.leaves(manual)[0]), rtol=1e-5, atol=1e-7)
 
 
+def test_epoch_end_accumulation_flush_matches_reference():
+    """The reference steps the optimizer at the epoch's LAST iteration even
+    mid-window (ref train.py:124: `... or (iteration == len(dataloader))`),
+    applying the partial micro-grad SUM. Three micro-steps at k=2 (emit
+    after 2, flush the trailing 1) must equal the hand-rolled sequence
+    p0 -SGD-> p0 - lr*(g1+g2) -SGD-> that - lr*g3. SGD+momentum makes both
+    the sum-vs-mean and the missing-flush errors observable."""
+    import optax as _optax
+    from real_time_helmet_detection_tpu.train import make_state_accum_flush
+
+    cfg = tiny_cfg(sub_divisions=2, optim="sgd", lr=1e-2)
+    model, tx, state = make_state(cfg)
+    mesh = make_mesh(1)
+    step = make_train_step(model, tx, cfg, mesh)
+    batches = [synthetic_batch(seed=s) for s in (21, 22, 23)]
+
+    st = jax.tree.map(lambda x: jnp.array(np.asarray(x)), state)
+    for b in batches:
+        st, _ = step(st, *shard_batch(mesh, b, spatial_dims=[1] * 5))
+    assert int(jax.device_get(st.opt_state.mini_step)) == 1  # trailing grad
+    flush = make_state_accum_flush(cfg, steps_per_epoch=3)
+    st = flush(st)
+    assert int(jax.device_get(st.opt_state.mini_step)) == 0
+    assert int(jax.device_get(st.opt_state.gradient_step)) == 2
+
+    # hand-rolled reference semantics through the plain optimizer
+    plain_cfg = tiny_cfg(sub_divisions=1, optim="sgd", lr=1e-2)
+    plain_tx = build_optimizer(plain_cfg, 2)
+    grad_fn = jax.grad(loss_fn, has_aux=True)
+    params, bs = state.params, state.batch_stats
+    opt = plain_tx.init(params)
+    g1, (bs, _) = grad_fn(params, bs, model,
+                          *[jnp.asarray(a) for a in batches[0]], cfg)
+    g2, (bs, _) = grad_fn(params, bs, model,
+                          *[jnp.asarray(a) for a in batches[1]], cfg)
+    summed = jax.tree.map(lambda a, b: a + b, g1, g2)
+    updates, opt = plain_tx.update(summed, opt, params)
+    params = _optax.apply_updates(params, updates)
+    g3, (bs, _) = grad_fn(params, bs, model,
+                          *[jnp.asarray(a) for a in batches[2]], cfg)
+    updates, opt = plain_tx.update(g3, opt, params)
+    params = _optax.apply_updates(params, updates)
+
+    np.testing.assert_allclose(
+        jax.device_get(jax.tree.leaves(st.params)[0]),
+        jax.device_get(jax.tree.leaves(params)[0]), rtol=1e-5, atol=1e-7)
+
+
 def test_checkpoint_roundtrip(tmp_path):
     cfg = tiny_cfg()
     model, tx, state = make_state(cfg)
