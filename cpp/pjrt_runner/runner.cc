@@ -59,6 +59,11 @@ std::string ReadFile(const std::string& path, bool binary = true) {
 
 const PJRT_Api* g_api = nullptr;
 
+// Test-only (--no-host-layout 1): omit the explicit row-major host_layout
+// request so CI can prove the stub plugin catches the device-layout bug
+// class the r2 hardware run exposed (tests/test_pjrt_runner.py).
+bool g_no_host_layout = false;
+
 void Check(PJRT_Error* err, const char* what) {
   if (err == nullptr) return;
   PJRT_Error_Message_Args margs;
@@ -146,7 +151,7 @@ HostOutput BufferToHost(PJRT_Buffer* buf) {
   std::memset(&args, 0, sizeof(args));
   args.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
   args.src = buf;
-  args.host_layout = &layout;
+  args.host_layout = g_no_host_layout ? nullptr : &layout;
   Check(g_api->PJRT_Buffer_ToHostBuffer(&args), "query host size");
   out.bytes.resize(args.dst_size);
   args.dst = out.bytes.data();
@@ -174,6 +179,8 @@ int main(int argc, char** argv) {
     if (!std::strcmp(argv[i], "--image")) image_path = argv[i + 1];
     else if (!std::strcmp(argv[i], "--iters")) iters = std::atoi(argv[i + 1]);
     else if (!std::strcmp(argv[i], "--depth")) depth = std::atoi(argv[i + 1]);
+    else if (!std::strcmp(argv[i], "--no-host-layout"))
+      g_no_host_layout = std::atoi(argv[i + 1]) != 0;
     else if (!std::strcmp(argv[i], "--opt")) {
       std::string kv = argv[i + 1];
       auto eq = kv.find('=');

@@ -144,10 +144,11 @@ def main() -> None:
             log("prior %s unreadable (%r); treating as absent" % (path, e))
             return None
 
-    # TPU owns the canonical filename UNCONDITIONALLY: a CPU smoke run on a
-    # fresh artifacts dir must not claim sweep.json first and shunt every
-    # later TPU sweep to a suffixed file (review finding on the r2 advisor
-    # fix, which only protected whichever platform wrote first)
+    # TPU owns the canonical filename UNCONDITIONALLY: non-TPU runs write
+    # to a platform-suffixed file, and a TPU run that finds a legacy
+    # non-TPU sweep.json (e.g. a pre-r3 CPU fallback) migrates it aside
+    # and takes the canonical path (review finding: the earlier version
+    # protected whichever platform wrote first).
     out_path = OUT_PATH if platform == "tpu" else \
         OUT_PATH.replace(".json", ".%s.json" % platform)
     if out_path != OUT_PATH:
@@ -155,11 +156,18 @@ def main() -> None:
             % (out_path, OUT_PATH))
     prior = read_prior(out_path)
     if prior is not None and prior.get("platform") != platform:
-        # e.g. a pre-r3 sweep.json written by a CPU fallback: step aside
-        out_path = out_path.replace(".json", ".%s.json" % platform)
-        log("prior is platform=%r; diverting to %s"
-            % (prior.get("platform"), out_path))
-        prior = read_prior(out_path)
+        if platform == "tpu":
+            aside = OUT_PATH.replace(
+                ".json", ".%s.json" % prior.get("platform", "unknown"))
+            os.replace(out_path, aside)
+            log("migrated legacy platform=%r sweep.json aside to %s"
+                % (prior.get("platform"), aside))
+        else:
+            # a mismatched prior in an already-suffixed file is garbage;
+            # never double-suffix — treat it as absent
+            log("prior in %s is platform=%r; ignoring it"
+                % (out_path, prior.get("platform")))
+        prior = None
     if prior is not None and only:
         results = merge_prior(results, prior, only)
 

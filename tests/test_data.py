@@ -209,6 +209,63 @@ def test_parser_skips_placeholder_objects():
     assert l.tolist() == [0]
 
 
+def test_scenes_fixture_is_hard_but_well_formed(tmp_path):
+    """The round-3 'scenes' fixture must actually deliver the properties
+    that de-saturate the quality signal (round-2 verdict weak #5): wide
+    head-scale range, SHWD-like class imbalance, crowded images, and
+    overlap-capped (not overlap-free) placement — while every box stays a
+    valid in-bounds annotation the encoder accepts."""
+    import numpy as np
+
+    from real_time_helmet_detection_tpu.data import make_synthetic_voc
+    from real_time_helmet_detection_tpu.data.voc import VOCDataset
+    root = make_synthetic_voc(str(tmp_path), num_train=30, num_test=5,
+                              imsize=(256, 256), max_objects=10, seed=11,
+                              style="scenes")
+    ds = VOCDataset(root, "trainval")
+    sizes, counts, per_image = [], {0: 0, 1: 0}, []
+    for i in range(len(ds)):
+        img, boxes, labels, _ = ds[i]
+        assert img.shape == (256, 256, 3)
+        per_image.append(len(boxes))
+        for b, l in zip(boxes, labels):
+            assert 0 <= b[0] < b[2] <= 256 and 0 <= b[1] < b[3] <= 256
+            counts[int(l)] += 1
+            sizes.append(max(b[2] - b[0], b[3] - b[1]))
+    sizes = np.asarray(sizes)
+    assert sizes.size >= 60                       # crowded overall
+    assert sizes.max() / sizes.min() >= 4.0       # real scale range
+    hat_frac = counts[0] / sizes.size
+    assert 0.55 <= hat_frac <= 0.9                # imbalanced like SHWD
+    assert max(per_image) >= 5                    # some crowded scenes
+
+    # every annotated head must keep pixel evidence: no head box may be
+    # (near-)contained in another (the placement caps intersection over
+    # min-area, which a plain IoU cap misses for a tiny head inside a
+    # huge one — review finding on the first scenes version)
+    for i in range(len(ds)):
+        _, bxs, _, _ = ds[i]
+        for a in range(len(bxs)):
+            for b in range(len(bxs)):
+                if a == b:
+                    continue
+                ax1, ay1, ax2, ay2 = bxs[a]
+                bx1, by1, bx2, by2 = bxs[b]
+                iw = min(ax2, bx2) - max(ax1, bx1)
+                ih = min(ay2, by2) - max(ay1, by1)
+                if iw > 0 and ih > 0:
+                    frac = iw * ih / ((ax2 - ax1) * (ay2 - ay1))
+                    assert frac <= 0.6, "head %d buried under head %d" % (a, b)
+
+
+def test_scenes_fixture_rejects_unknown_style(tmp_path):
+    import pytest as _pytest
+
+    from real_time_helmet_detection_tpu.data import make_synthetic_voc
+    with _pytest.raises(ValueError):
+        make_synthetic_voc(str(tmp_path), style="wat")
+
+
 def test_parser_self_closed_filename_is_empty_string():
     """A self-closed <filename/> parses to "" (the r2 parser rewrite's
     convention); consumers must use `get("filename") or fallback` — a bare

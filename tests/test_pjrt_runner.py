@@ -80,6 +80,60 @@ def test_runner_pipelined_depth_matches_sequential(runner_build, export_dir):
     assert "det[1] cls=1 score=0.800 box=(50.0, 60.0, 70.0, 80.0)" in r.stdout
 
 
+@pytest.fixture(scope="module")
+def export_dir_u8(tmp_path_factory):
+    """Raw-uint8-input export (--export-raw-input): the r2 real-plugin run
+    used f32 only, so the u8 wire path had no runner coverage."""
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.export import export_predict
+
+    out = str(tmp_path_factory.mktemp("export_u8"))
+    cfg = Config(num_stack=1, hourglass_inch=16, num_cls=2, imsize=64,
+                 save_path=out, export_raw_input=True)
+    export_predict(cfg, out)
+    return out
+
+
+def test_runner_uint8_raw_input_export(runner_build, export_dir_u8, tmp_path):
+    """The runner must honor meta.json's input_dtype=uint8: 1-byte H2D
+    elements and a correctly-sized image file."""
+    import numpy as np
+    runner, stub = runner_build
+    img = tmp_path / "img.u8"
+    np.random.default_rng(0).integers(0, 255, (1, 64, 64, 3),
+                                      dtype=np.uint8).tofile(img)
+    r = subprocess.run([runner, stub, export_dir_u8, "--iters", "2",
+                        "--image", str(img)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+    assert "det[0] cls=0 score=0.900 box=(10.0, 20.0, 30.0, 40.0)" in r.stdout
+    # and a wrong-sized (f32) image for a u8 export must fail loudly
+    bad = tmp_path / "img.f32"
+    np.zeros((1, 64, 64, 3), np.float32).tofile(bad)
+    r = subprocess.run([runner, stub, export_dir_u8, "--image", str(bad)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode != 0
+    assert "size mismatch" in r.stderr
+
+
+def test_stub_catches_dropped_host_layout(runner_build, export_dir):
+    """The stub must be able to CATCH the r2 hardware bug class (runner
+    omitted host_layout -> transposed boxes). The runner's test-only
+    --no-host-layout flag reproduces the bug; the stub then serves its raw
+    column-major device bytes and the detection printout MUST be wrong —
+    proving the hermetic suite would now fail if the layout request were
+    ever dropped."""
+    runner, stub = runner_build
+    r = subprocess.run([runner, stub, export_dir, "--iters", "2",
+                        "--no-host-layout", "1"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # device-layout bytes: box coordinates interleave across detections,
+    # so the canned row-major detection line cannot appear
+    assert "box=(10.0, 20.0, 30.0, 40.0)" not in r.stdout
+
+
 def test_runner_rejects_bad_export_dir(runner_build, tmp_path):
     runner, stub = runner_build
     r = subprocess.run([runner, stub, str(tmp_path)], capture_output=True,
