@@ -143,6 +143,9 @@ class Config:
     # transiently holds a second on-device copy of the train state, so
     # avoid when already at the HBM limit (e.g. --remat-sized configs)
     remat: bool = False           # rematerialize hourglass stacks in bwd
+    stem_s2d: bool = False        # compute the 7x7 s2 stem conv in its
+    # space-to-depth formulation (same arithmetic, MXU-friendlier
+    # contraction; checkpoint-compatible either way)
     # (trade FLOPs for HBM: fits num-stack=4 @ 768^2 batches)
     hang_warn_seconds: float = 300.0  # watchdog: warn when no train step
     # completes for this long (0 disables). Remote-TPU transports can

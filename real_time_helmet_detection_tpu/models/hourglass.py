@@ -113,6 +113,54 @@ class Pool(nn.Module):
         raise NotImplementedError("Not expected pool: %s" % name)
 
 
+class StemConv(nn.Module):
+    """7x7 stride-2 conv with an optional space-to-depth formulation.
+
+    The stem contracts over only kh*kw*3 = 147 input values per output —
+    the 3-channel axis starves the MXU's 128-wide contraction lanes. The
+    s2d path computes the SAME sums as a 4x4 stride-1 conv over the 2x2
+    space-to-depth input (12 channels): kernel padded 7->8 top-left and
+    regrouped so output(i,j) = sum W8[2a+p, 2b+q, c] * x[2(i+a-2)+p,
+    2(j+b-2)+q, c] — bit-equal arithmetic, different loop order (the
+    MLPerf ResNet trick, re-derived for this geometry). Param tree is
+    IDENTICAL to nn.Conv ('kernel' (7,7,C,F) + 'bias'), so checkpoints
+    are interchangeable across --stem-s2d on/off.
+    """
+    features: int
+    s2d: bool = False
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (7, 7, c, self.features))
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,))
+        dt = self.dtype or x.dtype
+        x = x.astype(dt)
+        k = kernel.astype(dt)
+        dn = ("NHWC", "HWIO", "NHWC")
+        # the s2d regrouping needs even H and W; odd sizes (legal for the
+        # direct conv) silently take the direct path rather than dying in
+        # an opaque reshape error mid-trace
+        if not self.s2d or x.shape[1] % 2 or x.shape[2] % 2:
+            y = jax.lax.conv_general_dilated(
+                x, k, (2, 2), ((3, 3), (3, 3)), dimension_numbers=dn)
+        else:
+            b, h, w, _ = x.shape
+            xs = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2,
+                                                        4 * c)
+            k8 = jnp.pad(k, ((1, 0), (1, 0), (0, 0), (0, 0)))
+            ks = k8.reshape(4, 2, 4, 2, c, self.features)
+            ks = ks.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c,
+                                                        self.features)
+            y = jax.lax.conv_general_dilated(
+                xs, ks, (1, 1), ((2, 1), (2, 1)), dimension_numbers=dn)
+        return y + bias.astype(dt)
+
+
 class Convolution(nn.Module):
     """Conv -> optional BN -> activation (ref hourglass.py:94-108), with the
     reference's symmetric (k-1)//2 padding."""
@@ -124,13 +172,21 @@ class Convolution(nn.Module):
     activation: str = "ReLU"
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
+    stem_s2d: bool = False  # use the space-to-depth stem formulation
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         k, p = self.kernel_size, (self.kernel_size - 1) // 2
-        x = nn.Conv(self.out_ch, (k, k), strides=(self.stride, self.stride),
-                    padding=((p, p), (p, p)), use_bias=self.use_bias,
-                    dtype=self.dtype)(x)
+        if self.stem_s2d and k == 7 and self.stride == 2 and self.use_bias:
+            # name matches the nn.Conv auto-name so the param tree (and
+            # every checkpoint) is identical whichever path computes it
+            x = StemConv(self.out_ch, s2d=True, dtype=self.dtype,
+                         name="Conv_0")(x)
+        else:
+            x = nn.Conv(self.out_ch, (k, k),
+                        strides=(self.stride, self.stride),
+                        padding=((p, p), (p, p)), use_bias=self.use_bias,
+                        dtype=self.dtype)(x)
         if self.bn:
             x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                              epsilon=1e-5, dtype=self.dtype,
@@ -214,12 +270,14 @@ class PreLayer(nn.Module):
     pool: str = "Max"
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
+    stem_s2d: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
         x = Convolution(64, 7, 2, use_bias=True, bn=True,
-                        activation=self.activation, **kw)(x, train)
+                        activation=self.activation,
+                        stem_s2d=self.stem_s2d, **kw)(x, train)
         x = Residual(self.mid_ch, **kw)(x, train)
         x = Pool(self.mid_ch, self.pool, dtype=self.dtype)(x)
         x = Residual(self.mid_ch, **kw)(x, train)
@@ -278,6 +336,7 @@ class StackedHourglass(nn.Module):
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
     remat: bool = False  # rematerialize each Hourglass stack in backward
+    stem_s2d: bool = False  # MXU-friendly space-to-depth stem conv
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -285,7 +344,7 @@ class StackedHourglass(nn.Module):
         if self.dtype is not None:
             x = x.astype(self.dtype)
         x = PreLayer(mid_ch=128, out_ch=self.in_ch, activation=self.activation,
-                     pool=self.pool, **kw)(x, train)
+                     pool=self.pool, stem_s2d=self.stem_s2d, **kw)(x, train)
 
         # --remat trades FLOPs for HBM: each stack's activations are
         # recomputed during backward instead of stored — the lever that
@@ -333,4 +392,5 @@ def build_model(args_or_cfg, dtype: Optional[Dtype] = None,
         dtype=dtype,
         bn_axis_name=bn_axis_name,
         remat=getattr(c, "remat", False),
+        stem_s2d=getattr(c, "stem_s2d", False),
     )

@@ -91,8 +91,13 @@ def child(n: int, per_chip_batch: int, imsize: int, iters: int,
     np.asarray(step(state, *arrs)[1])  # compile + warm (donates `state`)
     state = create_train_state(model, cfg, jax.random.key(0), imsize, tx)
     dt = timed_fetch(step, (state, *arrs), overhead, repeats=1)
+    platform = jax.devices()[0].platform
     print(json.dumps({
-        "devices": n, "platform": jax.devices()[0].platform,
+        "devices": n, "platform": platform,
+        # virtual CPU devices share host cores: such rows validate the
+        # sharding/collectives ONLY and must never be read as hardware
+        # scaling evidence (round-2 verdict weak #1)
+        "hardware_signal": platform == "tpu",
         "spatial": spatial,
         "img_per_sec": round(batch * iters / dt, 2),
         "img_per_sec_per_chip": round(per_chip_batch * iters / dt, 2),
@@ -183,18 +188,58 @@ def main() -> None:
             continue
         results.append(json.loads(r.stdout.strip().splitlines()[-1]))
 
-    # efficiency vs the smallest successful device count (n=1 for a 1D data
-    # mesh; n=spatial is the natural floor of a 2D mesh)
-    ok = sorted((r for r in results if "img_per_sec_per_chip" in r),
-                key=lambda r: r["devices"])
-    base = ok[0]["img_per_sec_per_chip"] if ok else None
+    # merge with prior rows so a real-chip anchor and virtual sharding rows
+    # can coexist in one artifact: a row is identified by
+    # (devices, spatial, hardware_signal, imsize)
+    prior_rows = []
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prior_rows = json.load(f).get("results", [])
+        except (json.JSONDecodeError, OSError):
+            prior_rows = []
+
+    _KEY_FIELDS = ("devices", "spatial", "hardware_signal", "imsize",
+                   "per_chip_batch")
+
+    def key(r):
+        return tuple(r.get(k) for k in _KEY_FIELDS)
+
     for r in results:
-        if base and "img_per_sec_per_chip" in r:
+        r["imsize"] = imsize
+        r["per_chip_batch"] = per_chip
+    # legacy rows (pre-tagging schema) are dropped entirely: they lack the
+    # key fields, could never be replaced, and a stale untagged row must
+    # not survive as the efficiency anchor (review finding)
+    prior_rows = [r for r in prior_rows
+                  if all(k in r for k in _KEY_FIELDS)]
+    new_keys = {key(r) for r in results}
+    results = [r for r in prior_rows if key(r) not in new_keys] + results
+
+    # efficiency vs the smallest device count of the SAME measurement
+    # class (hardware_signal, imsize, per_chip_batch, spatial): a
+    # virtual-CPU row must never be normalized against a real-chip anchor,
+    # nor a 64^2 row against a 512^2 one (round-2 verdict weak #1)
+    def eff_class(r):
+        return (r.get("hardware_signal"), r.get("imsize"),
+                r.get("per_chip_batch"), r.get("spatial"))
+
+    classes = {eff_class(r) for r in results if "img_per_sec_per_chip" in r}
+    for cls in classes:
+        ok = sorted((r for r in results
+                     if "img_per_sec_per_chip" in r and eff_class(r) == cls),
+                    key=lambda r: r["devices"])
+        base = ok[0]["img_per_sec_per_chip"]
+        for r in ok:
             r["efficiency"] = round(r["img_per_sec_per_chip"] / base, 4)
             r["efficiency_base_devices"] = ok[0]["devices"]
 
-    out = {"per_chip_batch": per_chip, "imsize": imsize, "iters": iters,
-           "spatial": args.spatial, "results": results}
+    out = {"per_chip_batch": per_chip, "iters": iters,
+           "note": ("rows with hardware_signal=false ran on virtual CPU "
+                    "devices sharing host cores: they validate sharding/"
+                    "collectives only, NOT hardware scaling; efficiency is "
+                    "computed within each hardware class separately"),
+           "results": results}
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))

@@ -254,6 +254,38 @@ def main() -> None:
     scv = jax.jit(stemconv.init)(key, images[:1])
     measure("conv7x7s2_3to64", lambda x: stemconv.apply(scv, x), images, nb)
 
+    # the same stem in its space-to-depth formulation (--stem-s2d): same
+    # arithmetic, 12-channel contraction — the MXU-starvation A/B
+    from real_time_helmet_detection_tpu.models.hourglass import StemConv
+    s2d = StemConv(64, s2d=True, dtype=dtype)
+    s2dv = jax.jit(s2d.init)(key, images[:1])
+    measure("conv7x7s2_s2d", lambda x: s2d.apply(s2dv, x), images, nb)
+
+    # full train step with --stem-s2d, for the end-to-end delta
+    try:
+        import dataclasses as _dc
+        cfg_s2d = _dc.replace(cfg, stem_s2d=True)
+        model_s2d = build_model(cfg_s2d, dtype=dtype)
+        tx2 = build_optimizer(cfg_s2d, 100)
+        st2 = create_train_state(model_s2d, cfg_s2d, key, imsize, tx2)
+        body2 = make_train_step_body(model_s2d, tx2, cfg_s2d)
+        train2 = make_scanned_train_fn(body2, n)
+        c2 = jax.jit(train2, donate_argnums=(0,)).lower(st2, *arrs).compile()
+        fl2 = flops_of(c2)
+        np.asarray(c2(st2, *arrs)[1])
+        st2 = create_train_state(model_s2d, cfg_s2d, key, imsize, tx2)
+        dt2 = timed_fetch(c2, (st2, *arrs), overhead, repeats=1)
+        rec2 = {"ms": round(dt2 / n * 1e3, 3)}
+        if fl2:
+            rec2["mfu"] = round(fl2 * n / dt2 / peak, 4)
+        results["components"]["train_step_stem_s2d"] = rec2
+        log("train_step_stem_s2d: %s" % rec2)
+        flush()
+    except Exception as e:  # noqa: BLE001
+        results["components"]["train_step_stem_s2d"] = {
+            "error": str(e).splitlines()[-1][:200]}
+        flush()
+
     bnm = nn.BatchNorm(use_running_average=False, momentum=0.9, epsilon=1e-5,
                        dtype=dtype)
     bv = jax.jit(bnm.init)(key, feat[:1])

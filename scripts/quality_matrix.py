@@ -77,12 +77,30 @@ def main() -> None:
         OUT_PATH = "/tmp/qmatrix_smoke/quality_matrix.json"
         import jax
         jax.config.update("jax_platforms", "cpu")
-    if not os.path.exists(os.path.join(DATA_ROOT, "ImageSets")):
+    # dataset reuse is gated on the GENERATION PARAMETERS, not bare dir
+    # existence: a stale smaller pipe-clean dataset must be regenerated,
+    # not silently trained on while the artifact records the larger sizes
+    # (review finding)
+    ds_meta = {"n_train": n_train, "n_test": n_test, "imsize": imsize}
+    meta_path = os.path.join(DATA_ROOT, "dataset_meta.json")
+    have = None
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                have = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            have = None
+    if have != ds_meta:
+        if os.path.isdir(DATA_ROOT):
+            import shutil
+            shutil.rmtree(DATA_ROOT)
         log("generating scenes dataset (%d train / %d test @%d^2)..."
             % (n_train, n_test, imsize))
         make_synthetic_voc(DATA_ROOT, num_train=n_train, num_test=n_test,
                            imsize=(imsize, imsize), max_objects=12, seed=42,
                            style="scenes")
+        with open(meta_path, "w") as f:
+            json.dump(ds_meta, f)
 
     results = {"fixture": "scenes", "imsize": imsize, "n_train": n_train,
                "n_test": n_test, "epochs": epochs, "rows": {}}

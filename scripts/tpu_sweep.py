@@ -159,6 +159,12 @@ def main() -> None:
         if platform == "tpu":
             aside = OUT_PATH.replace(
                 ".json", ".%s.json" % prior.get("platform", "unknown"))
+            n = 1
+            while os.path.exists(aside):  # never clobber a newer suffixed
+                aside = OUT_PATH.replace(  # file with the legacy one
+                    ".json", ".%s.%d.json" % (prior.get("platform",
+                                                        "unknown"), n))
+                n += 1
             os.replace(out_path, aside)
             log("migrated legacy platform=%r sweep.json aside to %s"
                 % (prior.get("platform"), aside))

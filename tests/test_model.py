@@ -200,3 +200,49 @@ def test_remat_matches_plain_forward_and_grads():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-3, atol=1e-5 * gmax)
     jax.tree.map(close, g1, g2)
+
+
+def test_stem_s2d_matches_direct_conv():
+    """--stem-s2d computes the SAME stem arithmetic via a space-to-depth
+    4x4 stride-1 conv: identical param tree (checkpoint-compatible) and
+    near-identical outputs (float summation order may differ)."""
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.models import build_model
+
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.standard_normal((2, 64, 64, 3)).astype(np.float32))
+    cfg_a = Config(num_stack=1, hourglass_inch=16, num_cls=2)
+    cfg_b = Config(num_stack=1, hourglass_inch=16, num_cls=2, stem_s2d=True)
+    ma, mb = build_model(cfg_a), build_model(cfg_b)
+    va = ma.init(jax.random.key(0), x, train=False)
+    vb = mb.init(jax.random.key(0), x, train=False)
+    # identical param paths AND identical init values (same RNG folding)
+    la = jax.tree_util.tree_leaves_with_path(va)
+    lb = jax.tree_util.tree_leaves_with_path(vb)
+    assert [p for p, _ in la] == [p for p, _ in lb]
+    for (_, a), (_, b) in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    ya = ma.apply(va, x, train=False)
+    yb = mb.apply(vb, x, train=False)
+    np.testing.assert_allclose(np.asarray(ya), np.asarray(yb),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_stem_s2d_checkpoints_interchangeable():
+    """Weights trained without --stem-s2d must load and produce the same
+    predictions with it (and vice versa): the flag is a pure compute-path
+    switch."""
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.models import build_model
+
+    rng = np.random.default_rng(10)
+    x = jnp.asarray(rng.standard_normal((1, 64, 64, 3)).astype(np.float32))
+    cfg_a = Config(num_stack=1, hourglass_inch=16, num_cls=2)
+    cfg_b = Config(num_stack=1, hourglass_inch=16, num_cls=2, stem_s2d=True)
+    ma, mb = build_model(cfg_a), build_model(cfg_b)
+    va = ma.init(jax.random.key(3), x, train=False)
+    # apply model A's variables through model B's compute path
+    yb = mb.apply(va, x, train=False)
+    ya = ma.apply(va, x, train=False)
+    np.testing.assert_allclose(np.asarray(ya), np.asarray(yb),
+                               rtol=2e-4, atol=2e-5)
