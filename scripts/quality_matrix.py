@@ -134,6 +134,7 @@ def main() -> None:
             end_epoch=epochs, device_augment=True, cache_device=True,
             multiscale_flag=False, multiscale=[imsize, imsize, 64],
             ema_decay=0.998, keep_ckpt=2, ckpt_interval=5,
+            auto_resume=2,  # ride out tunnel blips inside a training row
             hang_warn_seconds=1200, num_workers=8, print_interval=10)
         base.update(kw)
         return Config(**base)

@@ -7,7 +7,8 @@ of `shard_batch` (make_array_from_process_local_data, parallel/mesh.py) and
 one sharded train step: the exact code path a real multi-host TPU run uses
 over DCN (≡ reference mp.spawn + NCCL worker, /root/reference/train.py:23-45).
 
-Usage: python distributed_worker.py <rank> <world> <port> <outdir> [ndev_local]
+Usage: python distributed_worker.py <rank> <world> <port> <outdir>
+       [ndev_local] [spatial]
 """
 
 import json
@@ -19,6 +20,11 @@ rank, world, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
 # devices contributed by THIS process (multi-device-per-host = the real
 # pod topology: a v5e host drives 4-8 chips)
 ndev_local = int(sys.argv[5]) if len(sys.argv) > 5 else 1
+# spatial axis of the global 2D (data x spatial) mesh. make_mesh keeps
+# spatial MINOR, so spatial pairs land on one process's local devices
+# (halos on intra-host links; only the DP all-reduce crosses processes) —
+# the deliberate pod layout, see test_two_process_2d_mesh_matches_single
+spatial = int(sys.argv[6]) if len(sys.argv) > 6 else 1
 
 os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d"
                            % ndev_local)
@@ -52,7 +58,7 @@ def main() -> None:
     assert len(jax.devices()) == world * ndev_local
     assert len(jax.local_devices()) == ndev_local
 
-    mesh = make_mesh(world * ndev_local)
+    mesh = make_mesh(world * ndev_local, spatial=spatial)
     model = build_model(cfg)
     tx = build_optimizer(cfg, 10)
     state = create_train_state(model, cfg, jax.random.key(0), IMSIZE, tx)
