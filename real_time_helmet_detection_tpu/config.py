@@ -107,7 +107,10 @@ class Config:
     focal_beta: float = 4.0
 
     # network
-    scale_factor: int = 4
+    scale_factor: int = 4        # structurally 4: PreLayer's stem downsample
+    # is 2x conv + 2x pool (ref hourglass.py:163-165); unlike the reference
+    # (which reads it in decode only and would silently mis-decode,
+    # SURVEY §5 dead flags) any other value fails loudly in __post_init__
     num_cls: int = 2
     pretrained: str = "imagenet"  # selects normalization stats only (as ref)
     normalized_coord: bool = False
@@ -170,6 +173,13 @@ class Config:
     # transient backend error at that step, to exercise --auto-resume
     save_path: str = "./WEIGHTS/"
     profile: bool = False         # jax.profiler trace of early train steps
+
+    def __post_init__(self):
+        if self.scale_factor != 4:
+            raise ValueError(
+                "--scale_factor must be 4: the stem's 4x downsample is "
+                "structural (ref hourglass.py:163-165); other values would "
+                "mis-size the encoded GT maps vs the network output")
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -66,3 +66,15 @@ def test_get_config_eval_reads_checkpoint_snapshot(tmp_path):
                            "--save-path", str(tmp_path / "eval")])
     assert eval_cfg.num_stack == 2 and eval_cfg.activation == "Mish"
     assert eval_cfg.imsize == 512
+
+
+def test_scale_factor_must_be_four():
+    """The stem's 4x downsample is structural; the reference silently
+    mis-decodes for other values (SURVEY §5 dead flags) — here it fails
+    loudly at config construction."""
+    import pytest
+
+    from real_time_helmet_detection_tpu.config import Config
+    with pytest.raises(ValueError, match="structural"):
+        Config(scale_factor=8)
+    Config(scale_factor=4)  # default passes
