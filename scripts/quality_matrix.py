@@ -12,6 +12,7 @@ class imbalance) and records held-out mAP for each lever:
   base+ema    same training's EMA weight stream       (eval only;
               the base run trains with --ema-decay so both weight sets
               come out of ONE run — ref has no EMA at all)
+  base+pool5  same weights, 5x5 peak window           (eval only)
   stack2      num_stack=2                             (1 training)
   multiscale  bucketed {384,448,512} on a 576 canvas  (1 training)
 
@@ -191,7 +192,8 @@ def main() -> None:
 
     # ---- base training (also yields EMA weights + soft-NMS eval rows) ---
     base_save = os.path.join(WORK_ROOT, "base")
-    if want("base") or want("base+soft") or want("base+ema"):
+    if want("base") or want("base+soft") or want("base+ema") \
+            or want("base+pool5"):
         run_training(base_save, train_cfg(base_save))
     if want("base"):
         t0 = time.time()
@@ -207,6 +209,13 @@ def main() -> None:
         m = evaluate(eval_cfg(base_save, latest_ckpt(base_save),
                               ema_eval=True, ema_decay=0.998))
         record("base+ema", m, t0, base_save)
+    if want("base+pool5"):
+        # the newly-threaded --pool-size lever: a wider peak window on the
+        # same weights (eval only)
+        t0 = time.time()
+        m = evaluate(eval_cfg(base_save, latest_ckpt(base_save),
+                              pool_size=5))
+        record("base+pool5", m, t0, base_save)
 
     # ---- num_stack=2 ----------------------------------------------------
     if want("stack2"):
