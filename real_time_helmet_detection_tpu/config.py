@@ -173,6 +173,9 @@ class Config:
     # transient backend error at that step, to exercise --auto-resume
     save_path: str = "./WEIGHTS/"
     profile: bool = False         # jax.profiler trace of early train steps
+    summary: bool = True          # print a layer table at train start on
+    # the chief (≡ reference torchsummary on rank 0, ref train.py:50;
+    # --no-summary disables). Shape inference only — no device compute.
 
     def __post_init__(self):
         if self.scale_factor != 4:

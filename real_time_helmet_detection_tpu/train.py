@@ -948,6 +948,18 @@ def train(cfg: Config) -> TrainState:
         nparams = sum(x.size for x in jax.tree.leaves(state.params))
         print("%s: model built, %d params, mesh %s" % (
             timestamp(), nparams, dict(mesh.shape)), flush=True)
+        if cfg.summary:
+            # layer table (≡ reference torchsummary on rank 0, ref
+            # train.py:50). nn.tabulate shape-infers via jax.eval_shape; a
+            # HOST numpy input keeps the image tensor off the device (one
+            # ~70 ms tunnel dispatch per eager op otherwise; only the tiny
+            # RNG key is device-side — tabulate requires a real key).
+            import flax.linen as nn
+            print(nn.tabulate(
+                model, jax.random.key(0), depth=2,
+                compute_flops=False, compute_vjp_flops=False)(
+                    np.zeros((1, imsize, imsize, 3), np.float32),
+                    train=False), flush=True)
 
     if cfg.async_ckpt and jax.process_count() > 1:
         # the chief-only device-side snapshot + orbax save would touch

@@ -104,9 +104,12 @@ def test_dp_gradients_match_single_device():
     np.testing.assert_allclose(p1, p8, rtol=1e-4, atol=1e-6)
 
 
-def test_spatial_sharding_matches_pure_dp():
-    """(data=4, spatial=2) must be numerically equivalent to (8, 1)."""
-    cfg = tiny_cfg(batch_size=8)
+@pytest.mark.parametrize("stem_s2d", [False, True])
+def test_spatial_sharding_matches_pure_dp(stem_s2d):
+    """(data=4, spatial=2) must be numerically equivalent to (8, 1) — with
+    both stem formulations (--stem-s2d's H reshape must compose with the
+    spatial sharding of H)."""
+    cfg = tiny_cfg(batch_size=8, stem_s2d=stem_s2d)
     model, tx, state = make_state(cfg)
     batch_np = synthetic_batch(b=8, seed=5)
 
@@ -540,20 +543,3 @@ def test_fit_data_mesh_rejects_unfit_spatial():
         fit_data_mesh(8, num_devices=1, spatial=2)  # 1 usable < spatial
     with pytest.raises(ValueError, match="spatial"):
         fit_data_mesh(8, spatial=3)  # 3 does not divide 8 visible
-
-
-def test_stem_s2d_spatial_sharding_matches_dp():
-    """--stem-s2d's H reshape must compose with spatial sharding of H:
-    (8,1) and (4,2) meshes produce the same loss with the s2d stem."""
-    cfg = tiny_cfg(batch_size=8, stem_s2d=True)
-    model, tx, state = make_state(cfg)
-    batch_np = synthetic_batch(b=8, seed=6)
-    results = []
-    for spatial in (1, 2):
-        mesh = make_mesh(8, spatial=spatial)
-        step = make_train_step(model, tx, cfg, mesh)
-        st = jax.tree.map(lambda x: jnp.array(np.asarray(x)), state)
-        st, losses = step(st, *shard_batch(mesh, batch_np,
-                                           spatial_dims=[1] * 5))
-        results.append(float(losses["total"]))
-    assert results[0] == pytest.approx(results[1], rel=1e-4)
