@@ -1,0 +1,21 @@
+"""Eval-only quality levers on the finished CPU calibration checkpoint."""
+import json, sys
+sys.path.insert(0, "/root/repo")
+import jax; jax.config.update("jax_platforms", "cpu")
+from real_time_helmet_detection_tpu.config import Config
+from real_time_helmet_detection_tpu.evaluate import evaluate
+
+base = dict(train_flag=False, data="/tmp/scenes_calib",
+            save_path="/tmp/scenes_calib_w",
+            model_load="/tmp/scenes_calib_w/check_point_60",
+            num_stack=1, hourglass_inch=32, num_cls=2, batch_size=4,
+            imsize=256, conf_th=0.05, topk=100, num_workers=6)
+out = {}
+for row, kw in [("hard_nms", {}), ("soft_nms", {"nms": "soft-nms"}),
+                ("pool5", {"pool_size": 5})]:
+    m = evaluate(Config(**{**base, **kw}))
+    out[row] = {"mAP": round(float(m["map"]), 4),
+                "ap_hat": round(float(m["ap"].get(0, -1)), 4),
+                "ap_person": round(float(m["ap"].get(1, -1)), 4)}
+    print(row, out[row], flush=True)
+print(json.dumps(out))

@@ -1,0 +1,38 @@
+"""Same overfit budget (the suite's calibrated test_overfit_learns recipe),
+both fixture styles: the overfit-mAP gap is the hardness evidence."""
+import json, os, shutil, sys, time
+sys.path.insert(0, "/root/repo")
+import jax; jax.config.update("jax_platforms", "cpu")
+from real_time_helmet_detection_tpu.config import Config
+from real_time_helmet_detection_tpu.data import make_synthetic_voc
+from real_time_helmet_detection_tpu.evaluate import evaluate
+from real_time_helmet_detection_tpu.train import train
+
+out = {}
+for style in ("blocks", "scenes"):
+    root = "/tmp/fxh2_%s" % style
+    shutil.rmtree(root, ignore_errors=True)
+    make_synthetic_voc(root, num_train=6, num_test=4, imsize=(96, 72),
+                       seed=1, style=style)
+    # overfit semantics: evaluate on the memorized train images
+    shutil.copy(os.path.join(root, "ImageSets", "Main", "trainval.txt"),
+                os.path.join(root, "ImageSets", "Main", "test.txt"))
+    save = "/tmp/fxh2_%s_w" % style
+    shutil.rmtree(save, ignore_errors=True)
+    os.makedirs(os.path.join(save, "training_log"), exist_ok=True)
+    base = dict(num_stack=2, hourglass_inch=16, num_cls=2, topk=10,
+                conf_th=0.1, nms_th=0.5, batch_size=2, num_workers=2)
+    cfg = Config(train_flag=True, data=root, save_path=save, end_epoch=200,
+                 lr=1e-2, imsize=None, multiscale_flag=True,
+                 multiscale=[64, 128, 64], print_interval=1000, **base)
+    t0 = time.time()
+    train(cfg)
+    m = evaluate(Config(train_flag=False, data=root, save_path=save,
+                        model_load=save + "/check_point_200", imsize=64,
+                        **base))
+    out[style] = {"overfit_mAP": round(float(m["map"]), 4),
+                  "ap_hat": round(float(m["ap"].get(0, -1)), 4),
+                  "ap_person": round(float(m["ap"].get(1, -1)), 4),
+                  "wall_s": round(time.time() - t0, 1)}
+    print("STYLE", style, out[style], flush=True)
+print(json.dumps(out))

@@ -1,0 +1,30 @@
+"""Scenes fixture at a resolution where heads are visible: nonzero overfit
+mAP = learnable (harder, not noise)."""
+import json, os, shutil, sys, time
+sys.path.insert(0, "/root/repo")
+import jax; jax.config.update("jax_platforms", "cpu")
+from real_time_helmet_detection_tpu.config import Config
+from real_time_helmet_detection_tpu.data import make_synthetic_voc
+from real_time_helmet_detection_tpu.evaluate import evaluate
+from real_time_helmet_detection_tpu.train import train
+
+root = "/tmp/fxl_scenes"; save = "/tmp/fxl_scenes_w"
+for d in (root, save): shutil.rmtree(d, ignore_errors=True)
+make_synthetic_voc(root, num_train=6, num_test=4, imsize=(256, 256),
+                   seed=1, style="scenes", max_objects=6)
+shutil.copy(os.path.join(root, "ImageSets", "Main", "trainval.txt"),
+            os.path.join(root, "ImageSets", "Main", "test.txt"))
+os.makedirs(os.path.join(save, "training_log"), exist_ok=True)
+base = dict(num_stack=2, hourglass_inch=16, num_cls=2, topk=20,
+            conf_th=0.1, nms_th=0.5, batch_size=2, num_workers=2)
+cfg = Config(train_flag=True, data=root, save_path=save, end_epoch=200,
+             lr=1e-2, imsize=None, multiscale_flag=True,
+             multiscale=[128, 192, 64], print_interval=1000, **base)
+t0 = time.time()
+train(cfg)
+m = evaluate(Config(train_flag=False, data=root, save_path=save,
+                    model_load=save + "/check_point_200", imsize=128, **base))
+print(json.dumps({"overfit_mAP": round(float(m["map"]), 4),
+                  "ap_hat": round(float(m["ap"].get(0, -1)), 4),
+                  "ap_person": round(float(m["ap"].get(1, -1)), 4),
+                  "wall_s": round(time.time() - t0, 1)}))

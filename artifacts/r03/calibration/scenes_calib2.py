@@ -1,0 +1,26 @@
+"""stack2 lever on the same CPU-feasible calibration setup (same data,
+same budget semantics, num_stack=2) -> held-out mAP delta vs base."""
+import json, os, sys, time
+sys.path.insert(0, "/root/repo")
+import jax; jax.config.update("jax_platforms", "cpu")
+from real_time_helmet_detection_tpu.config import Config
+from real_time_helmet_detection_tpu.evaluate import evaluate
+from real_time_helmet_detection_tpu.train import train
+
+root, save = "/tmp/scenes_calib", "/tmp/scenes_calib2_w"
+os.makedirs(os.path.join(save, "training_log"), exist_ok=True)
+base = dict(num_stack=2, hourglass_inch=32, num_cls=2, batch_size=4,
+            num_workers=6)
+cfg = Config(train_flag=True, data=root, save_path=save, end_epoch=60,
+             lr=1e-3, lr_milestone=[30, 54], imsize=None,
+             multiscale_flag=True, multiscale=[256, 320, 64],
+             ckpt_interval=10, keep_ckpt=2, print_interval=200, **base)
+t0 = time.time()
+train(cfg)
+m = evaluate(Config(train_flag=False, data=root, save_path=save,
+                    model_load=save + "/check_point_60", imsize=256,
+                    conf_th=0.05, topk=100, **base))
+print(json.dumps({"held_out_mAP": round(float(m["map"]), 4),
+                  "ap_hat": round(float(m["ap"].get(0, -1)), 4),
+                  "ap_person": round(float(m["ap"].get(1, -1)), 4),
+                  "wall_s": round(time.time() - t0, 1)}), flush=True)

@@ -154,8 +154,10 @@ class Config:
     # completes for this long (0 disables). Remote-TPU transports can
     # wedge mid-run; the reference has no failure detection at all.
     ema_decay: float = 0.0        # keep an exponential moving average of
-    # the params inside the jitted step (0 disables). EMA weights usually
-    # evaluate to higher mAP; a capability the reference lacks.
+    # the params inside the jitted step (0 disables); a capability the
+    # reference lacks. Helps only when decay matches the training budget:
+    # the r3 calibration (256^2 scenes, 0.998) measured -3.2 mAP vs raw
+    # weights, so treat it as an opt-in lever to validate per run.
     ema_eval: bool = False        # evaluate/demo/export with the EMA
     # weights from the checkpoint (requires a --ema-decay training run)
     prewarm: bool = False         # compile every multiscale bucket before

@@ -174,17 +174,24 @@ def main() -> None:
         print("[scaling] n=%d (%s)..." % (n, "cpu-virtual" if use_cpu
                                           else "tpu"),
               file=sys.stderr, flush=True)
+        # error rows carry the FULL merge key (spatial/hardware_signal
+        # stamped here as the child would have reported them): without it,
+        # error rows for the same device count collide regardless of
+        # config and the legacy-row filter silently drops them on the
+        # next merge (r3 advisor finding)
+        err_tags = {"devices": n, "spatial": args.spatial,
+                    "hardware_signal": not use_cpu}
         try:
             r = subprocess.run(cmd, capture_output=True, text=True,
                                timeout=1200, env=env)
         except subprocess.TimeoutExpired:
             print("[scaling] n=%d TIMED OUT" % n, file=sys.stderr, flush=True)
-            results.append({"devices": n, "error": "timeout"})
+            results.append({**err_tags, "error": "timeout"})
             continue
         if r.returncode != 0:
             print("[scaling] n=%d FAILED:\n%s" % (n, r.stderr[-2000:]),
                   file=sys.stderr, flush=True)
-            results.append({"devices": n, "error": r.stderr[-500:]})
+            results.append({**err_tags, "error": r.stderr[-500:]})
             continue
         results.append(json.loads(r.stdout.strip().splitlines()[-1]))
 
@@ -213,6 +220,14 @@ def main() -> None:
     # not survive as the efficiency anchor (review finding)
     prior_rows = [r for r in prior_rows
                   if all(k in r for k in _KEY_FIELDS)]
+    # an error row must never EVICT a measured row with the same key: a
+    # wedged-tunnel rerun that times out would otherwise destroy the
+    # real-chip anchor it failed to re-measure (review finding). The error
+    # row is dropped in that case — the measured evidence wins.
+    measured_keys = {key(r) for r in prior_rows
+                     if "img_per_sec_per_chip" in r}
+    results = [r for r in results
+               if not ("error" in r and key(r) in measured_keys)]
     new_keys = {key(r) for r in results}
     results = [r for r in prior_rows if key(r) not in new_keys] + results
 

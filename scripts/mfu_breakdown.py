@@ -45,7 +45,8 @@ from bench import (DEFAULT_PEAK, PEAK_BF16, acquire_backend, flops_of, log,
                    measure_dispatch_overhead, timed_fetch)
 
 OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "artifacts", "r03", "mfu_breakdown.json")
+    os.path.abspath(__file__))), "artifacts",
+    os.environ.get("GRAFT_ROUND", "r04"), "mfu_breakdown.json")
 
 # v5e HBM bandwidth (jax-ml scaling-book): ~819 GB/s.
 HBM_GBPS = {"v5e": 819e9, "v5 lite": 819e9, "v4": 1228e9, "v5p": 2765e9,
@@ -58,7 +59,10 @@ def bytes_of(compiled) -> float | None:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
-        return float(cost.get("bytes accessed", None))
+        val = cost.get("bytes accessed")
+        # metric absent is expected on some plugins; do not route it
+        # through the blanket except meant for real cost-analysis failures
+        return float(val) if val is not None else None
     except Exception:  # noqa: BLE001
         return None
 

@@ -35,7 +35,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "artifacts", "r03", "quality_matrix.json")
+    os.path.abspath(__file__))), "artifacts",
+    os.environ.get("GRAFT_ROUND", "r04"), "quality_matrix.json")
 DATA_ROOT = "/tmp/voc_scenes_512"
 WORK_ROOT = "/tmp/qmatrix"
 

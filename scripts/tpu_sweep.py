@@ -13,9 +13,10 @@ independently guarded: a failed compile (e.g. OOM at large batch) records
 the error string instead of killing the sweep.
 
 The dev tunnel can wedge mid-run (CLAUDE.md), so results MERGE into
-artifacts/r02/sweep.json after every single config — a killed run loses at
-most the in-flight config — and `--only <section>[,<section>]` reruns just
-the missing sections (inference, train, stack2, remat).
+artifacts/<round>/sweep.json (round from $GRAFT_ROUND, default r04) after
+every single config — a killed run loses at most the in-flight config —
+and `--only <section>[,<section>]` reruns just the missing sections
+(inference, train, stack2, remat, stack4_768).
 """
 
 from __future__ import annotations
@@ -53,12 +54,14 @@ def memory_analysis_of(compiled):
 
 
 OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "artifacts", "r03", "sweep.json")
+    os.path.abspath(__file__))), "artifacts",
+    os.environ.get("GRAFT_ROUND", "r04"), "sweep.json")
 
 # section name (CLI --only vocabulary) -> results key
 SECTION_KEYS = {"inference": "inference_batch_sweep",
                 "train": "train_batch_sweep",
-                "stack2": "num_stack2", "remat": "remat"}
+                "stack2": "num_stack2", "remat": "remat",
+                "stack4_768": "stack4_768"}
 
 
 def merge_prior(results: dict, prior: dict, only: set) -> dict:
@@ -129,7 +132,7 @@ def main() -> None:
         "platform": platform, "device_kind": device_kind, "imsize": imsize,
         "dispatch_ms": round(overhead * 1e3, 3),
         "inference_batch_sweep": [], "train_batch_sweep": [],
-        "num_stack2": {}, "remat": [],
+        "num_stack2": {}, "remat": [], "stack4_768": [],
     }
     def read_prior(path):
         """Prior results at `path`, or None if absent/unreadable — a kill
@@ -219,15 +222,16 @@ def main() -> None:
             rec["mfu_fwd"] = round(fl * n / dt / peak, 4)
         return rec
 
-    def bench_train(num_stack, batch, n, remat):
+    def bench_train(num_stack, batch, n, remat, imsize_=None):
+        sz = imsize_ or imsize
         cfg = Config(num_stack=num_stack, hourglass_inch=128, num_cls=2,
-                     batch_size=batch, amp=True, imsize=imsize, remat=remat)
+                     batch_size=batch, amp=True, imsize=sz, remat=remat)
         model = build_model(cfg, dtype=jnp.bfloat16)
         tx = build_optimizer(cfg, 100)
-        state = create_train_state(model, cfg, jax.random.key(0), imsize, tx)
+        state = create_train_state(model, cfg, jax.random.key(0), sz, tx)
         body = make_train_step_body(model, tx, cfg)
         arrs = tuple(jnp.asarray(a) for a in synthetic_target_batch(
-            batch, imsize, pos_rate=0.01))
+            batch, sz, pos_rate=0.01))
         train_n = make_scanned_train_fn(body, n)
         t0 = time.perf_counter()
         compiled = jax.jit(train_n, donate_argnums=(0,)).lower(
@@ -236,9 +240,10 @@ def main() -> None:
         fl = flops_of(compiled)
         mem = memory_analysis_of(compiled)
         np.asarray(compiled(state, *arrs)[1])  # warmup (donates state)
-        state = create_train_state(model, cfg, jax.random.key(0), imsize, tx)
+        state = create_train_state(model, cfg, jax.random.key(0), sz, tx)
         dt = timed_fetch(compiled, (state, *arrs), overhead, repeats=1)
-        rec = {"batch": batch, "remat": remat,
+        rec = {"batch": batch, "remat": remat, "imsize": sz,
+               "num_stack": num_stack,
                "img_per_sec_chip": round(batch * n / dt, 1),
                "step_ms": round(dt / n * 1e3, 3),
                "compile_s": round(compile_s, 1)}
@@ -312,6 +317,26 @@ def main() -> None:
                     {"batch": batch, "remat": remat,
                      "error": str(e).splitlines()[-1][:200]})
                 log("remat b=%d FAILED: %r" % (batch, e))
+            flush()
+
+    # --- 5. BASELINE config #4: num_stack=4 @768^2 with remat -------------
+    # (BASELINE.json configs[3]; remat is the memory lever that makes this
+    # fit — record step time, MFU and the HBM high-water from XLA's
+    # memory analysis. Smaller batch first: the known-good compile.)
+    if want("stack4_768"):
+        for batch, remat in ([(8, True), (16, True), (16, False)] if on_tpu
+                             else [(1, True)]):
+            n = 8 if on_tpu else 2
+            try:
+                rec = bench_train(4, batch, n, remat=remat,
+                                  imsize_=768 if on_tpu else 64)
+                results["stack4_768"].append(rec)
+                log("stack4_768 b=%d remat=%s: %s" % (batch, remat, rec))
+            except Exception as e:  # noqa: BLE001
+                results["stack4_768"].append(
+                    {"batch": batch, "remat": remat,
+                     "error": str(e).splitlines()[-1][:200]})
+                log("stack4_768 b=%d FAILED: %r" % (batch, e))
             flush()
 
     flush()

@@ -60,7 +60,10 @@ def test_flops_of_matmul_matches_analytic():
 def test_sweep_merge_prior_keeps_only_unrerun_sections():
     sweep = _load_sweep()
     fresh = {"platform": "tpu", "inference_batch_sweep": [],
-             "train_batch_sweep": [], "num_stack2": {}, "remat": []}
+             "train_batch_sweep": [], "num_stack2": {}, "remat": [],
+             "stack4_768": []}
+    # prior predates the stack4_768 section (an r3-era sweep.json): the
+    # merge must fall back to the fresh empty section, not crash
     prior = {"platform": "tpu",
              "inference_batch_sweep": [{"batch": 8, "img_per_sec": 1.0}],
              "train_batch_sweep": [{"batch": 16, "img_per_sec_chip": 2.0}],
@@ -70,6 +73,7 @@ def test_sweep_merge_prior_keeps_only_unrerun_sections():
     assert out["train_batch_sweep"] == []
     assert out["inference_batch_sweep"] == prior["inference_batch_sweep"]
     assert out["num_stack2"] == prior["num_stack2"]
+    assert out["stack4_768"] == []
 
 
 def test_sweep_merge_prior_rejects_other_platform():
@@ -80,7 +84,8 @@ def test_sweep_merge_prior_rejects_other_platform():
     import pytest
     sweep = _load_sweep()
     fresh = {"platform": "tpu", "inference_batch_sweep": [],
-             "train_batch_sweep": [], "num_stack2": {}, "remat": []}
+             "train_batch_sweep": [], "num_stack2": {}, "remat": [],
+             "stack4_768": []}
     prior = {"platform": "cpu",
              "inference_batch_sweep": [{"batch": 1, "img_per_sec": 9.0}]}
     with pytest.raises(ValueError, match="platform mismatch"):
@@ -90,4 +95,5 @@ def test_sweep_merge_prior_rejects_other_platform():
 def test_sweep_section_keys_cover_all_result_lists():
     sweep = _load_sweep()
     assert set(sweep.SECTION_KEYS.values()) == {
-        "inference_batch_sweep", "train_batch_sweep", "num_stack2", "remat"}
+        "inference_batch_sweep", "train_batch_sweep", "num_stack2", "remat",
+        "stack4_768"}
