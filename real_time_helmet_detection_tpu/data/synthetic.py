@@ -134,20 +134,30 @@ def _draw_person(draw, rng, cx: int, cy: int, r: float, helmeted: bool):
     return x1, int(round(top)), x2, int(round(cy + ry))
 
 
-def _draw_scene(rng, w: int, h: int, max_objects: int):
+def _draw_scene(rng, w: int, h: int, max_objects: int,
+                head_div_range=(28.0, 3.8)):
     """Hard fixture scene (round-3): textured clutter, 5-10x head-scale
     range, aspect jitter, occlusion (bodies/heads may overlap up to an IoU
     cap), helmet-colored decoys, and SHWD-like class imbalance
     (~72% helmeted). Small far heads drawn first so near objects occlude
-    them, like a real crowd photograph."""
+    them, like a real crowd photograph.
+
+    `head_div_range` = (far_div, near_div): head diameters are log-uniform
+    in [min_dim/far_div, min_dim/near_div]. The default spans ~8x down to
+    sub-heatmap-cell heads (the quality-matrix regime); raising the far
+    divisor keeps every head resolvable at stride 4 on a small, fast
+    canvas — the "scaled glyphs" lever for a suite-budget fixture whose
+    mAP sits in the discriminative band rather than pinned at 0 (round-3
+    verdict weak #5)."""
     img = _textured_background(rng, w, h)
     draw = ImageDraw.Draw(img)
     min_dim = min(w, h)
+    far_div, near_div = head_div_range
     proposals = []
     for _ in range(int(rng.integers(1, max_objects + 1))):
-        # log-uniform head radius: ~8x scale range
-        r = float(np.exp(rng.uniform(np.log(min_dim / 28.0),
-                                     np.log(min_dim / 3.8)))) / 2.0
+        # log-uniform head diameter across [min/far_div, min/near_div]
+        r = float(np.exp(rng.uniform(np.log(min_dim / far_div),
+                                     np.log(min_dim / near_div)))) / 2.0
         helmeted = rng.random() < 0.72  # SHWD-like imbalance
         proposals.append((r, helmeted))
     proposals.sort(key=lambda p: p[0])  # far (small) first
@@ -209,7 +219,8 @@ def _draw_scene(rng, w: int, h: int, max_objects: int):
 def make_synthetic_voc(root: str, num_train: int = 8, num_test: int = 4,
                        imsize: Tuple[int, int] = (160, 120),
                        max_objects: int = 3, seed: int = 0,
-                       style: str = "blocks") -> str:
+                       style: str = "blocks",
+                       head_div_range=(28.0, 3.8)) -> str:
     """Write a synthetic VOC2028-layout dataset under `root`; returns root.
 
     style="blocks": the easy r1/r2 fixture (opaque separated rectangles) —
@@ -236,7 +247,8 @@ def make_synthetic_voc(root: str, num_train: int = 8, num_test: int = 4,
             names.append(fname)
             w, h = imsize
             if style == "scenes":
-                img, boxes = _draw_scene(rng, w, h, max_objects)
+                img, boxes = _draw_scene(rng, w, h, max_objects,
+                                         head_div_range=head_div_range)
                 quality = int(rng.integers(60, 92))
             else:
                 img, boxes = _draw_blocks(rng, w, h, max_objects)

@@ -69,29 +69,39 @@ def evaluate(cfg: Config) -> Dict:
     """
     from .metrics import compute_map, write_detection_txt
 
-    if jax.process_count() > 1:
-        # Explicitly unsupported rather than silently single-host (round-2
-        # verdict weak #6): the mAP reduction needs every process's
-        # detections on one host, and JAX has no object-gather — a
-        # multi-host eval would shard the split by rank (BatchLoader
-        # already supports rank/world_size) and gather fixed-shape
-        # Detections via multihost_utils. Until that exists, evaluate on
-        # one host: the full test split fits a single chip in seconds.
-        raise ValueError(
-            "evaluate() is single-host: run it on one process (it shards "
-            "over that host's local devices automatically)")
+    # Multi-host: each process scores its `indices[rank::world]` shard of
+    # the test split (BatchLoader's DistributedSampler-equivalent) on its
+    # own local device, then fixed-shape detection blocks are allgathered
+    # via multihost_utils and scored identically on every process (rank 0
+    # owns the txt/pickle side effects). The reference eval is single-GPU
+    # only (ref evaluate.py:16); this extends it to the pod shapes the
+    # training path already supports. The rendezvous lives HERE, not in
+    # the caller, so the production CLI (`main.py --world-size 2 --rank N`
+    # in eval mode) reaches the sharded path exactly like train() does
+    # (review finding: without it every process would silently evaluate
+    # the full split independently).
+    from .parallel import init_distributed
+    init_distributed(cfg)
+    rank, world = jax.process_index(), jax.process_count()
     model, variables = load_eval_state(cfg)
     # Multi-device eval: shard the batch over a data mesh when the batch
     # divides the device count (single-host; the reference's eval is
     # single-GPU only, ref evaluate.py:16). Oversized meshes are trimmed
     # to the batch-divisible prefix rather than skipping DP entirely.
     mesh = None
-    from .parallel import fit_data_mesh, make_mesh
-    ndev = fit_data_mesh(cfg.batch_size, cfg.num_devices)
-    if ndev > 1:
-        mesh = make_mesh(ndev)
-        print("%s: eval sharded over %d devices"
-              % (timestamp(), ndev), flush=True)
+    if world == 1:
+        from .parallel import fit_data_mesh, make_mesh
+        ndev = fit_data_mesh(cfg.batch_size, cfg.num_devices)
+        if ndev > 1:
+            mesh = make_mesh(ndev)
+            print("%s: eval sharded over %d devices"
+                  % (timestamp(), ndev), flush=True)
+    else:
+        # per-process single-device predict: the split shard is process-
+        # local numpy, so a global mesh would mis-shard it; cross-process
+        # work happens only at the final allgather
+        print("%s: multi-host eval rank %d/%d (split sharded by rank)"
+              % (timestamp(), rank, world), flush=True)
     # raw wire: images ship as uint8 canvases and are normalized on-device
     # inside the jitted predict program (see make_predict_fn)
     predict = make_predict_fn(model, cfg, normalize=cfg.pretrained,
@@ -104,7 +114,7 @@ def evaluate(cfg: Config) -> Dict:
                          scale_factor=cfg.scale_factor,
                          max_boxes=cfg.max_boxes, shuffle=False,
                          drop_last=False, num_workers=cfg.num_workers,
-                         raw=True)
+                         rank=rank, world_size=world, raw=True)
 
     txt_dir = os.path.join(cfg.save_path, "results", "txt")
     results: Dict[str, Dict] = {}
@@ -140,10 +150,13 @@ def evaluate(cfg: Config) -> Dict:
             scores = dets.scores[b][keep]
             results[image_id] = {"box": boxes, "cls": classes,
                                  "score": scores}
-            write_detection_txt(txt_dir, image_id, boxes, classes, scores)
-            # GT at original scale for the hermetic mAP
-            gb, gl = boxes_from_voc_dict(info)
-            gt_boxes[image_id], gt_labels[image_id] = gb, gl
+            if world == 1:
+                # multi-host defers all side effects to rank 0 after the
+                # allgather, and scores GT from the local XML files
+                write_detection_txt(txt_dir, image_id, boxes, classes,
+                                    scores)
+                gb, gl = boxes_from_voc_dict(info)
+                gt_boxes[image_id], gt_labels[image_id] = gb, gl
 
     # Software-pipelined loop (same shape as the async train loop): batch
     # i's device arrays are left un-fetched while batch i+1 is loaded and
@@ -189,6 +202,11 @@ def evaluate(cfg: Config) -> Dict:
         consume(jax.device_get(pending[0]), pending[1])
         meters["consume"].update(time.time() - t0)
 
+    if world > 1:
+        m = _score_multihost(cfg, dataset, results, txt_dir, rank, world)
+        m["timing"] = {k: v.avg for k, v in meters.items()}
+        return m
+
     save_pickle(os.path.join(cfg.save_path, "prediction_results.pickle"),
                 results)
 
@@ -203,6 +221,98 @@ def evaluate(cfg: Config) -> Dict:
         ", ".join("%s %.4f" % (names[c], ap) for c, ap in m["ap"].items())),
         flush=True)
     m["timing"] = {k: v.avg for k, v in meters.items()}
+    return m
+
+
+def _score_multihost(cfg: Config, dataset, results: Dict, txt_dir: str,
+                     rank: int, world: int) -> Dict:
+    """Gather every rank's detections and score the full split.
+
+    JAX has no object gather, so each rank packs its (already rescaled-to-
+    original-size) detections into fixed-shape blocks — `M` images of at
+    most `num_stack * topk` boxes, `M = ceil(n_images / world)` identical
+    on every rank because `epoch_indices` wrap-pads the split — and the
+    blocks are allgathered with `multihost_utils.process_allgather`.
+    Wrap-padded duplicate images are deduped by id (first occurrence
+    wins). Every process computes the same mAP from the same gathered
+    data; rank 0 owns the txt/pickle side effects. GT comes from each
+    process's own copy of the annotation XMLs (every host mounts the full
+    dataset, exactly as in training)."""
+    import xml.etree.ElementTree as ET
+
+    from jax.experimental import multihost_utils
+
+    from .data.voc import boxes_from_voc_dict, parse_voc_xml
+    from .metrics import compute_map, write_detection_txt
+
+    id_bytes = 64
+    D = cfg.num_stack * cfg.topk
+    M = -(-len(dataset) // world)
+    ids = np.zeros((M, id_bytes), np.uint8)
+    boxes = np.zeros((M, D, 4), np.float32)
+    classes = np.zeros((M, D), np.int32)
+    scores = np.zeros((M, D), np.float32)
+    nval = np.zeros((M,), np.int32)
+    for i, (image_id, r) in enumerate(sorted(results.items())):
+        enc = image_id.encode()
+        if len(enc) > id_bytes:
+            raise ValueError("image id %r exceeds the %d-byte gather slot"
+                             % (image_id, id_bytes))
+        ids[i, :len(enc)] = np.frombuffer(enc, np.uint8)
+        n = min(len(r["box"]), D)
+        boxes[i, :n] = r["box"][:n]
+        classes[i, :n] = r["cls"][:n]
+        scores[i, :n] = r["score"][:n]
+        nval[i] = n
+
+    # (world, M, ...) stacked blocks, identical on every process
+    g_ids, g_boxes, g_classes, g_scores, g_nval = (
+        np.asarray(multihost_utils.process_allgather(x))
+        for x in (ids, boxes, classes, scores, nval))
+
+    id2ann = dict(zip(dataset.ids, dataset.annotations))
+    det_b: Dict[str, np.ndarray] = {}
+    det_l: Dict[str, np.ndarray] = {}
+    det_s: Dict[str, np.ndarray] = {}
+    gt_boxes: Dict[str, np.ndarray] = {}
+    gt_labels: Dict[str, np.ndarray] = {}
+    for p in range(world):
+        for i in range(M):
+            iid = bytes(g_ids[p, i]).rstrip(b"\0").decode()
+            if not iid or iid in det_b:  # pad row / wrap duplicate
+                continue
+            if iid not in id2ann:
+                # consume()'s synthetic fallback ids (self-closed
+                # <filename/>) cannot be mapped back to an annotation on a
+                # foreign rank; refuse loudly rather than scoring a split
+                # with silently-dropped images
+                raise ValueError(
+                    "multi-host eval cannot resolve image id %r to an "
+                    "annotation file (images must carry real <filename> "
+                    "tags)" % iid)
+            n = int(g_nval[p, i])
+            det_b[iid] = g_boxes[p, i, :n]
+            det_l[iid] = g_classes[p, i, :n]
+            det_s[iid] = g_scores[p, i, :n]
+            voc = parse_voc_xml(ET.parse(id2ann[iid]).getroot())
+            gb, gl = boxes_from_voc_dict(voc)
+            gt_boxes[iid], gt_labels[iid] = gb, gl
+
+    m = compute_map(gt_boxes, gt_labels, det_b, det_l, det_s,
+                    num_cls=cfg.num_cls)
+    if rank == 0:
+        for iid in det_b:
+            write_detection_txt(txt_dir, iid, det_b[iid], det_l[iid],
+                                det_s[iid])
+        save_pickle(
+            os.path.join(cfg.save_path, "prediction_results.pickle"),
+            {k: {"box": det_b[k], "cls": det_l[k], "score": det_s[k]}
+             for k in det_b})
+        names = {c: INDEX2CLASS.get(c, str(c)) for c in m["ap"]}
+        print("%s: multi-host mAP %.4f over %d images (%s)" % (
+            timestamp(), m["map"], len(det_b),
+            ", ".join("%s %.4f" % (names[c], ap)
+                      for c, ap in m["ap"].items())), flush=True)
     return m
 
 

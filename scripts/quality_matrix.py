@@ -11,7 +11,12 @@ class imbalance) and records held-out mAP for each lever:
   base+soft   same weights, soft-NMS eval             (eval only)
   base+ema    same training's EMA weight stream       (eval only;
               the base run trains with --ema-decay so both weight sets
-              come out of ONE run — ref has no EMA at all)
+              come out of ONE run — ref has no EMA at all. decay 0.998
+              is budget-appropriate for this run: horizon 1/(1-d) = 500
+              steps ~ 28% of the 45ep x 40step budget, spanning the
+              final LR-drop phase — the regime EMA is meant for; the r3
+              -3.2 mAP result used the same horizon at a 600-step-shorter
+              budget, so this row resolves decay-vs-budget with data)
   base+pool5  same weights, 5x5 peak window           (eval only)
   stack2      num_stack=2                             (1 training)
   multiscale  bucketed {384,448,512} on a 576 canvas  (1 training)

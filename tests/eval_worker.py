@@ -1,0 +1,53 @@
+"""Worker for the multi-host evaluation test (test_distributed.py).
+
+Each process evaluates its `indices[rank::world]` shard of the test split
+and joins the fixed-shape detection allgather in `_score_multihost`
+(evaluate.py) — the pod-shape eval path the reference lacks entirely (ref
+evaluate.py:16 is single-GPU). With world=1 the same worker runs the
+plain single-host path, giving the test a like-for-like oracle: identical
+weights (same init seed), identical split, different process topology.
+
+Usage: python eval_worker.py <rank> <world> <port> <outdir> <dataroot>
+"""
+
+import json
+import os
+import sys
+
+rank, world, port, outdir, dataroot = (int(sys.argv[1]), int(sys.argv[2]),
+                                       int(sys.argv[3]), sys.argv[4],
+                                       sys.argv[5])
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from real_time_helmet_detection_tpu.config import Config  # noqa: E402
+from real_time_helmet_detection_tpu.evaluate import evaluate  # noqa: E402
+
+
+def main() -> None:
+    save = os.path.join(outdir, "w%d_rank%d" % (world, rank))
+    os.makedirs(save, exist_ok=True)
+    cfg = Config(train_flag=False, data=dataroot, save_path=save,
+                 num_stack=1, hourglass_inch=16, num_cls=2, batch_size=2,
+                 imsize=64, topk=20, conf_th=0.01, nms="nms", nms_th=0.5,
+                 num_workers=2, world_size=world, rank=rank,
+                 dist_url="tcp://127.0.0.1:%d" % port)
+    # the rendezvous is evaluate()'s own (production CLI path); the worker
+    # only checks it actually happened
+    m = evaluate(cfg)
+    assert jax.process_count() == world, jax.process_count()
+    out = {"map": float(m["map"]),
+           "ap": {str(k): float(v) for k, v in m["ap"].items()}}
+    with open(os.path.join(outdir, "eval_w%d_rank%d.json"
+                           % (world, rank)), "w") as f:
+        json.dump(out, f)
+    print("eval rank %d/%d ok: %s" % (rank, world, out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -150,3 +150,79 @@ def test_four_process_train_step_matches_single(tmp_path):
     assert results[0]["total"] == pytest.approx(single_total, rel=1e-4)
     assert results[0]["param0"] == pytest.approx(single_p0, rel=1e-4,
                                                  abs=1e-6)
+
+
+EVAL_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "eval_worker.py")
+
+
+def test_two_process_eval_matches_single(tmp_path):
+    """Multi-host evaluation (round-3 verdict #5): 2 processes each score
+    their rank shard of the test split, allgather fixed-shape detection
+    blocks (`_score_multihost`), and every rank must report the SAME mAP —
+    equal to the single-process evaluation of the identical split with the
+    identical (seed-deterministic) weights. Also cross-checks the per-image
+    detections rank 0 persisted against the single-process pickle."""
+    import pickle
+
+    from real_time_helmet_detection_tpu.data import make_synthetic_voc
+
+    dataroot = tmp_path / "voc"
+    make_synthetic_voc(str(dataroot), num_train=2, num_test=6,
+                       imsize=(64, 64), seed=11)
+
+    def run(world):
+        port = _free_port()
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        procs = [
+            subprocess.Popen(
+                [sys.executable, EVAL_WORKER, str(rank), str(world),
+                 str(port), str(tmp_path), str(dataroot)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                env=env)
+            for rank in range(world)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=540)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, "eval worker failed:\n%s" % out
+        results = []
+        for rank in range(world):
+            with open(tmp_path / ("eval_w%d_rank%d.json" % (world, rank))) \
+                    as f:
+                results.append(json.load(f))
+        return results
+
+    multi = run(world=2)
+    single = run(world=1)[0]
+
+    # every rank computed the same score from the same gathered data
+    assert multi[0]["map"] == pytest.approx(multi[1]["map"], abs=1e-9)
+    assert multi[0]["ap"] == multi[1]["ap"]
+    # and it equals the single-process evaluation of the same split
+    assert multi[0]["map"] == pytest.approx(single["map"], abs=1e-6)
+    for c, ap in single["ap"].items():
+        assert multi[0]["ap"][c] == pytest.approx(ap, abs=1e-6)
+
+    # per-image detections: rank 0's gathered pickle vs the single run's
+    with open(tmp_path / "w2_rank0" / "prediction_results.pickle",
+              "rb") as f:
+        p_multi = pickle.load(f)
+    with open(tmp_path / "w1_rank0" / "prediction_results.pickle",
+              "rb") as f:
+        p_single = pickle.load(f)
+    assert set(p_multi) == set(p_single)
+    for iid in p_single:
+        assert np.allclose(p_multi[iid]["box"], p_single[iid]["box"],
+                           atol=1e-4), iid
+        assert np.allclose(p_multi[iid]["score"], p_single[iid]["score"],
+                           atol=1e-5), iid
+        assert (np.asarray(p_multi[iid]["cls"])
+                == np.asarray(p_single[iid]["cls"])).all(), iid
