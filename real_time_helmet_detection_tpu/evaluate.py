@@ -246,6 +246,16 @@ def _score_multihost(cfg: Config, dataset, results: Dict, txt_dir: str,
     from .metrics import compute_map, write_detection_txt
 
     id_bytes = 64
+    # Validate id lengths on the FULL split — identical on every rank —
+    # BEFORE the collective: a rank-local raise inside the packing loop
+    # would leave the peer ranks blocked in process_allgather waiting for
+    # a collective that never arrives (review finding). Raising here is
+    # symmetric: every rank sees the same ids and fails the same way.
+    for _iid in dataset.ids:
+        if len(_iid.encode()) > id_bytes:
+            raise ValueError(
+                "image id %r exceeds the %d-byte multi-host gather slot"
+                % (_iid, id_bytes))
     D = cfg.num_stack * cfg.topk
     M = -(-len(dataset) // world)
     ids = np.zeros((M, id_bytes), np.uint8)
@@ -256,6 +266,10 @@ def _score_multihost(cfg: Config, dataset, results: Dict, txt_dir: str,
     for i, (image_id, r) in enumerate(sorted(results.items())):
         enc = image_id.encode()
         if len(enc) > id_bytes:
+            # real split ids were pre-validated above; only a synthetic
+            # consume() fallback id could trip this, and those are short —
+            # an overflow here is an invariant violation worth the
+            # (asymmetric) crash
             raise ValueError("image id %r exceeds the %d-byte gather slot"
                              % (image_id, id_bytes))
         ids[i, :len(enc)] = np.frombuffer(enc, np.uint8)
