@@ -1,0 +1,91 @@
+"""Calibrate a suite-budget scenes overfit gate (r3 verdict weak #5 / next #6).
+
+The r3 suite's scenes overfit pinned mAP at 0.000 (96x72 canvas: heads
+2-9 px, under the stride-4 heatmap's resolution) — a gate below the
+fixture's resolving power detects nothing. This driver searches the
+(canvas, head_div_range, epochs) space for a config whose
+train-on-6/eval-on-memorized mAP lands strictly inside (0.1, 0.9), where
+a real decode/loss regression moves the number.
+
+Writes scenes_gate_calib.json incrementally; run on CPU only.
+"""
+import json
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "scenes_gate_calib.json")
+results = {}
+
+
+def flush():
+    with open(OUT, "w") as f:
+        json.dump(results, f, indent=1)
+
+
+def run(tag, imsize, head_div, epochs, max_objects=8, lr=1e-2):
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.data import make_synthetic_voc
+    from real_time_helmet_detection_tpu.evaluate import evaluate
+    from real_time_helmet_detection_tpu.train import train
+
+    t0 = time.time()
+    root = "/tmp/scenes_gate/%s/voc" % tag
+    save = "/tmp/scenes_gate/%s/w" % tag
+    shutil.rmtree("/tmp/scenes_gate/%s" % tag, ignore_errors=True)
+    make_synthetic_voc(root, num_train=6, num_test=4,
+                       imsize=(imsize, imsize), max_objects=max_objects,
+                       seed=1, style="scenes", head_div_range=head_div)
+    # overfit semantics: evaluate on the memorized train images
+    shutil.copy(os.path.join(root, "ImageSets", "Main", "trainval.txt"),
+                os.path.join(root, "ImageSets", "Main", "test.txt"))
+    os.makedirs(os.path.join(save, "training_log"), exist_ok=True)
+    cfg = Config(num_stack=2, hourglass_inch=16, num_cls=2, topk=10,
+                 conf_th=0.1, nms_th=0.5, batch_size=2, num_workers=2,
+                 train_flag=True, data=root, save_path=save,
+                 end_epoch=epochs, lr=lr, imsize=None,
+                 multiscale_flag=True, multiscale=[imsize, imsize + 64, 64],
+                 print_interval=1000)
+    train(cfg)
+    ckpt = os.path.join(save, "check_point_%d" % epochs)
+    with open(os.path.join(ckpt, "loss_log.json")) as f:
+        log = json.load(f)
+    first = float(np.mean(log["total"][:10]))
+    last = float(np.mean(log["total"][-10:]))
+    m = evaluate(Config(num_stack=2, hourglass_inch=16, num_cls=2, topk=10,
+                        conf_th=0.1, nms_th=0.5, batch_size=2, num_workers=2,
+                        train_flag=False, data=root, save_path=save,
+                        model_load=ckpt, imsize=imsize))
+    results[tag] = {
+        "imsize": imsize, "head_div_range": list(head_div),
+        "epochs": epochs, "max_objects": max_objects, "lr": lr,
+        "loss_first10": round(first, 2), "loss_last10": round(last, 3),
+        "loss_ratio": round(first / max(last, 1e-9), 1),
+        "map": round(float(m["map"]), 4),
+        "ap": {str(k): round(float(v), 4) for k, v in m["ap"].items()},
+        "wall_s": round(time.time() - t0, 1)}
+    print("[calib] %s -> %s" % (tag, results[tag]), flush=True)
+    flush()
+
+
+if __name__ == "__main__":
+    # primary candidate: 128^2 canvas, heads ~10.7-42.7 px (all resolvable
+    # at stride 4), modest crowding
+    run("c128_div12_3_e120", 128, (12.0, 3.0), 120)
+    # fallbacks explored only if needed — comment/extend per result
+    if not (0.1 < results["c128_div12_3_e120"]["map"] < 0.9):
+        run("c128_div12_3_e200", 128, (12.0, 3.0), 200)
+    done = any(0.1 < r["map"] < 0.9 for r in results.values())
+    if not done:
+        run("c128_div8_3_e200", 128, (8.0, 3.0), 200, max_objects=6)
+    print("[calib] finished:", json.dumps(results), flush=True)
