@@ -332,7 +332,17 @@ def main() -> None:
         log("train bench failed: %r" % e)
 
     # --- Pallas fused peak kernel vs XLA path (TPU only) ------------------
-    if on_tpu:
+    # Runs in a TIME-BOUNDED daemon thread: the r4 first on-chip bench hung
+    # >30 min inside this section's remote compile (zero CPU accrual — the
+    # documented axon compile-poll hang) AFTER the headline sections had
+    # measured, and the one JSON line never printed. The headline metrics
+    # must never be hostage to the nice-to-have kernel A/B, least of all
+    # in the driver's round-end run. BENCH_PALLAS=0 skips entirely.
+    pallas_out: dict = {}  # thread-private; merged into `out` only after a
+    # successful join — the timeout path must not race json.dumps(out)
+    # against the thread's writes (review finding)
+
+    def _pallas_section():
         try:
             from real_time_helmet_detection_tpu.ops.pallas.peak import (
                 fused_peak_scores, peak_scores_reference)
@@ -366,16 +376,38 @@ def main() -> None:
             a = jax.vmap(lambda x: fused_peak_scores(x, interpret=False))(
                 logits)
             b = jax.vmap(peak_scores_reference)(logits)
-            out["pallas_matches_xla"] = bool(
+            pallas_out["pallas_matches_xla"] = bool(
                 np.array_equal(np.asarray(a), np.asarray(b)))
             tp = per_iter(lambda x: fused_peak_scores(x, interpret=False))
             txla = per_iter(peak_scores_reference)
-            out["peak_pallas_us"] = round(tp * 1e6, 3)
-            out["peak_xla_us"] = round(txla * 1e6, 3)
+            pallas_out["peak_pallas_us"] = round(tp * 1e6, 3)
+            pallas_out["peak_xla_us"] = round(txla * 1e6, 3)
             log("pallas peak: %.2f us vs xla %.2f us (match=%s)"
-                % (tp * 1e6, txla * 1e6, out["pallas_matches_xla"]))
+                % (tp * 1e6, txla * 1e6,
+                   pallas_out["pallas_matches_xla"]))
         except Exception as e:  # noqa: BLE001
             log("pallas bench failed: %r" % e)
+
+    if on_tpu and os.environ.get("BENCH_PALLAS", "1") != "0":
+        import threading
+        th = threading.Thread(target=_pallas_section, daemon=True)
+        th.start()
+        th.join(timeout=float(os.environ.get("BENCH_PALLAS_TIMEOUT_S",
+                                             "1200")))
+        if th.is_alive():
+            out["pallas_timeout"] = True
+            log("pallas section still running at timeout; reporting "
+                "without it")
+            print(json.dumps(out))
+            sys.stdout.flush()
+            # The hung compile's plugin threads may be non-daemon; force
+            # the exit so the JSON line above remains the process result.
+            # NOTE exiting mid-remote-compile can wedge the device claim
+            # (CLAUDE.md) — so queued contexts (tpu_chain.sh, the rerun
+            # watcher) set BENCH_PALLAS=0 and leave the kernel A/B to a
+            # standalone supervised run with nothing queued behind it.
+            os._exit(0)
+        out.update(pallas_out)
 
     print(json.dumps(out))
 

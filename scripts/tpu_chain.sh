@@ -11,6 +11,11 @@
 set -u
 cd /root/repo
 export BENCH_SKIP_PROBE=1 GRAFT_ROUND=r04
+# Queued context: skip bench's pallas A/B — its timeout path exits the
+# process mid-remote-compile, which can wedge the device claim and hang
+# every queued stage behind it. The kernel A/B runs standalone (nothing
+# queued behind it) instead.
+export BENCH_PALLAS=0
 mkdir -p artifacts/r04/logs
 
 stamp() { date -u '+%Y-%m-%dT%H:%M:%SZ'; }

@@ -1,0 +1,88 @@
+#!/bin/bash
+# Round-4 TPU chain, part 2. The relay tunnel died mid-round (its parent
+# orchestrator connection hit EOF ~04:42 UTC) while stage 2 (tpu_sweep)
+# was in backend init; the part-1 controller was stopped so the queued
+# stages wouldn't cascade-fail through the outage. The still-running
+# sweep process doubles as the claim waiter: its backend-init retries
+# block until the relay returns (or it dies UNAVAILABLE and the probe
+# loop below takes over the waiting).
+#
+#   SWEEP_PID=<pid> setsid nohup bash scripts/tpu_chain2.sh >> artifacts/r04/chain.log 2>&1 &
+set -u
+cd /root/repo
+export BENCH_SKIP_PROBE=1 GRAFT_ROUND=r04 BENCH_PALLAS=0
+
+stamp() { date -u '+%Y-%m-%dT%H:%M:%SZ'; }
+
+commit_art() {
+  for _ in 1 2 3; do
+    git add artifacts/r04 scaling.json 2>/dev/null \
+      && git commit -q -m "$1" 2>/dev/null && return 0
+    sleep 7
+  done
+  return 0
+}
+
+run_stage() { # run_stage <name> <cmd...>; periodic commit while it runs
+  local name=$1; shift
+  echo "$(stamp) stage $name START: $*"
+  "$@" >> "artifacts/r04/logs/$name.log" 2>&1 &
+  local pid=$!
+  while kill -0 "$pid" 2>/dev/null; do
+    sleep 60
+    if [ -n "$(git status --porcelain artifacts/r04 2>/dev/null)" ]; then
+      commit_art "r04 chain: $name incremental artifacts"
+    fi
+  done
+  wait "$pid"; local rc=$?
+  echo "$(stamp) stage $name DONE rc=$rc"
+  commit_art "r04 chain: $name artifacts (rc=$rc)"
+  return $rc
+}
+
+if [ -n "${SWEEP_PID:-}" ]; then
+  echo "$(stamp) chain2: waiting on sweep pid $SWEEP_PID"
+  while [ -d "/proc/$SWEEP_PID" ]; do sleep 60; done
+  echo "$(stamp) chain2: sweep exited"
+  commit_art "r04 chain: sweep artifacts"
+fi
+
+# Re-establish claim health before queuing more stages (the sweep may
+# have died UNAVAILABLE with the service still down). Same no-timeout
+# waiter as part 1.
+until python -c "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d; print('claim clear:', d)"; do
+  echo "$(stamp) probe exited nonzero (outage signature); retrying in 120s"
+  sleep 120
+done
+echo "$(stamp) chain2: TPU claim clear — resuming queued stages"
+
+run_stage mfu_breakdown python scripts/mfu_breakdown.py
+
+if run_stage scaling_anchor python scaling.py --tpu --devices 1; then
+  cp scaling.json artifacts/r04/scaling_anchor.json
+  commit_art "r04 chain: scaling hardware anchor"
+fi
+
+run_stage runner_early python scripts/runner_drive.py
+if [ -f artifacts/r04/runner_fps.json ]; then
+  mv artifacts/r04/runner_fps.json artifacts/r04/runner_fps_early.json
+  commit_art "r04 chain: early C++ runner FPS (untrained weights)"
+fi
+
+run_stage quality_matrix python scripts/quality_matrix.py
+
+run_stage runner_trained python scripts/runner_drive.py
+
+# headline bench rerun, pallas skipped (BENCH_PALLAS=0 above); only a
+# platform=tpu line may replace the on-chip artifact
+echo "$(stamp) stage bench_rerun START"
+python bench.py > /tmp/bench_rerun.json 2>> artifacts/r04/logs/bench_rerun.log
+rc=$?
+if [ $rc -eq 0 ] && grep -q '"platform": "tpu"' /tmp/bench_rerun.json; then
+  tail -1 /tmp/bench_rerun.json > artifacts/r04/BENCH_r04_local.json
+  commit_art "r04: on-chip bench artifact (post-chain rerun)"
+else
+  echo "$(stamp) bench rerun not TPU or failed (rc=$rc); artifact untouched"
+fi
+echo "$(stamp) stage bench_rerun DONE rc=$rc"
+echo "$(stamp) chain2 complete"
