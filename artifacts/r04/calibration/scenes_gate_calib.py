@@ -8,6 +8,9 @@ train-on-6/eval-on-memorized mAP lands strictly inside (0.1, 0.9), where
 a real decode/loss regression moves the number.
 
 Writes scenes_gate_calib.json incrementally; run on CPU only.
+
+POST-HOC: confounded — see scenes_gate_calib2.py's note (the default
+[50, 90] LR milestones stalled every run past epoch 90).
 """
 import json
 import os

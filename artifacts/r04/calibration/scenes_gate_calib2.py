@@ -5,6 +5,15 @@ Batch-1 finding: with the fixture's SHWD-like 72% helmeted rate, a
 0.0 in EVERY config (hat AP reached 0.14), dragging mAP under the 0.1
 band floor regardless of head scale. Batch 2 balances the classes via
 the new `helmeted_rate` knob and probes budget/capacity.
+
+POST-HOC: every batch-1/2/3 run was CONFOUNDED — none set
+`lr_milestone`, so the Config default [50, 90] decayed the LR to its
+floor at epoch 90 and all longer budgets trained at ~1e-4 from there.
+The out-of-band verdicts recorded in scenes_gate_calib{,2,3}.json say
+nothing about capacity or canvas size. The fix (milestones scaled to
+the run, scenes_gate_probe.json "c64_ms_e300") lands mAP 0.5833 with
+the SAME inch16 model batch 3 wrote off. Kept for the negative-result
+record only.
 """
 import json
 import os

@@ -8,6 +8,11 @@ recurring suite gate. Batch 3 shrinks the canvas to 64^2 with the
 head_div_range scaled so heads stay 10-29 px (well above stride-4
 resolution): cheap steps buy the epochs that clutter memorization
 actually needs, keeping the gate suite-affordable.
+
+POST-HOC: this batch's diagnosis was WRONG — see the confound note in
+scenes_gate_calib2.py (LR milestones defaulted to [50, 90], stalling
+every run at epoch 90). The canvas change was not the fix; the scaled
+milestones were (scenes_gate_probe.json).
 """
 import json
 import os

@@ -135,7 +135,7 @@ def _draw_person(draw, rng, cx: int, cy: int, r: float, helmeted: bool):
 
 
 def _draw_scene(rng, w: int, h: int, max_objects: int,
-                head_div_range=(28.0, 3.8)):
+                head_div_range=(28.0, 3.8), helmeted_rate: float = 0.72):
     """Hard fixture scene (round-3): textured clutter, 5-10x head-scale
     range, aspect jitter, occlusion (bodies/heads may overlap up to an IoU
     cap), helmet-colored decoys, and SHWD-like class imbalance
@@ -148,7 +148,11 @@ def _draw_scene(rng, w: int, h: int, max_objects: int,
     divisor keeps every head resolvable at stride 4 on a small, fast
     canvas — the "scaled glyphs" lever for a suite-budget fixture whose
     mAP sits in the discriminative band rather than pinned at 0 (round-3
-    verdict weak #5)."""
+    verdict weak #5). `helmeted_rate` keeps the SHWD-like ~72% imbalance
+    by default; a tiny overfit fixture (6 images) needs ~0.5 so the
+    person class has enough examples to learn at all — at 0.72 its AP
+    pins to 0 and drags mAP below the discriminative band regardless of
+    head scale (r4 calibration, artifacts/r04/calibration)."""
     img = _textured_background(rng, w, h)
     draw = ImageDraw.Draw(img)
     min_dim = min(w, h)
@@ -158,7 +162,7 @@ def _draw_scene(rng, w: int, h: int, max_objects: int,
         # log-uniform head diameter across [min/far_div, min/near_div]
         r = float(np.exp(rng.uniform(np.log(min_dim / far_div),
                                      np.log(min_dim / near_div)))) / 2.0
-        helmeted = rng.random() < 0.72  # SHWD-like imbalance
+        helmeted = rng.random() < helmeted_rate  # SHWD-like imbalance
         proposals.append((r, helmeted))
     proposals.sort(key=lambda p: p[0])  # far (small) first
     def covered_frac(a, b):
@@ -220,7 +224,8 @@ def make_synthetic_voc(root: str, num_train: int = 8, num_test: int = 4,
                        imsize: Tuple[int, int] = (160, 120),
                        max_objects: int = 3, seed: int = 0,
                        style: str = "blocks",
-                       head_div_range=(28.0, 3.8)) -> str:
+                       head_div_range=(28.0, 3.8),
+                       helmeted_rate: float = 0.72) -> str:
     """Write a synthetic VOC2028-layout dataset under `root`; returns root.
 
     style="blocks": the easy r1/r2 fixture (opaque separated rectangles) —
@@ -248,7 +253,8 @@ def make_synthetic_voc(root: str, num_train: int = 8, num_test: int = 4,
             w, h = imsize
             if style == "scenes":
                 img, boxes = _draw_scene(rng, w, h, max_objects,
-                                         head_div_range=head_div_range)
+                                         head_div_range=head_div_range,
+                                         helmeted_rate=helmeted_rate)
                 quality = int(rng.integers(60, 92))
             else:
                 img, boxes = _draw_blocks(rng, w, h, max_objects)

@@ -1,0 +1,69 @@
+#!/bin/bash
+# Round-4 TPU chain, part 3: recover the stages part 2 doesn't cover.
+# The sweep (stage 2 of part 1) died UNAVAILABLE after 77 min of
+# backend-init retries during the relay outage and produced nothing;
+# part 2's queue doesn't re-run it. This part waits for the part-2
+# controller to exit, re-runs the full sweep, and finishes with the
+# standalone pallas kernel A/B (BENCH_PALLAS=1 bench rerun) — last on
+# purpose: its timeout path may exit mid-remote-compile, and with
+# nothing queued behind it a wedged claim costs nothing.
+#
+#   CHAIN2_PID=<pid> setsid nohup bash scripts/tpu_chain3.sh >> artifacts/r04/chain.log 2>&1 &
+set -u
+cd /root/repo
+export BENCH_SKIP_PROBE=1 GRAFT_ROUND=r04
+
+stamp() { date -u '+%Y-%m-%dT%H:%M:%SZ'; }
+
+commit_art() {
+  for _ in 1 2 3; do
+    git add artifacts/r04 scaling.json 2>/dev/null \
+      && git commit -q -m "$1" 2>/dev/null && return 0
+    sleep 7
+  done
+  return 0
+}
+
+run_stage() {
+  local name=$1; shift
+  echo "$(stamp) stage $name START: $*"
+  "$@" >> "artifacts/r04/logs/$name.log" 2>&1 &
+  local pid=$!
+  while kill -0 "$pid" 2>/dev/null; do
+    sleep 60
+    if [ -n "$(git status --porcelain artifacts/r04 2>/dev/null)" ]; then
+      commit_art "r04 chain: $name incremental artifacts"
+    fi
+  done
+  wait "$pid"; local rc=$?
+  echo "$(stamp) stage $name DONE rc=$rc"
+  commit_art "r04 chain: $name artifacts (rc=$rc)"
+  return $rc
+}
+
+if [ -n "${CHAIN2_PID:-}" ]; then
+  echo "$(stamp) chain3: waiting on chain2 pid $CHAIN2_PID"
+  while [ -d "/proc/$CHAIN2_PID" ]; do sleep 120; done
+  echo "$(stamp) chain3: chain2 exited"
+fi
+
+until python -c "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d; print('claim clear:', d)"; do
+  echo "$(stamp) probe exited nonzero (outage signature); retrying in 120s"
+  sleep 120
+done
+echo "$(stamp) chain3: TPU claim clear"
+
+run_stage sweep python scripts/tpu_sweep.py
+
+# pallas kernel A/B, nothing queued behind it
+echo "$(stamp) stage pallas_ab START"
+BENCH_PALLAS=1 python bench.py > /tmp/bench_pallas.json 2>> artifacts/r04/logs/pallas_ab.log
+rc=$?
+if [ $rc -eq 0 ] && grep -q '"platform": "tpu"' /tmp/bench_pallas.json; then
+  tail -1 /tmp/bench_pallas.json > artifacts/r04/BENCH_r04_local.json
+  commit_art "r04: on-chip bench incl. pallas kernel A/B"
+else
+  echo "$(stamp) pallas_ab not TPU or failed (rc=$rc); artifact untouched"
+fi
+echo "$(stamp) stage pallas_ab DONE rc=$rc"
+echo "$(stamp) chain3 complete"
