@@ -1,0 +1,58 @@
+"""Full-budget two-bucket multiscale lever on the r3 CPU calibration
+setup (scenes 256^2, 160/48 split, inch32, 60 epochs, milestones
+[30, 54]) — the run r3 left at epoch 30/60 ("inconclusive", r3 README)
+and whose resume the r4 container restart killed. Re-run from scratch;
+directly comparable to r3's committed base row (held-out mAP 0.5305,
+hat 0.7451, person 0.3160 — artifacts/r03/README.md).
+
+Multiscale here means true two-bucket training: multiscale=[256, 384,
+64] samples {256, 320} per batch (ref data.py:153-159 semantics,
+bucketed static shapes for XLA). Eval stays at 256 like every other
+row. Outage insurance for the 512^2 TPU quality matrix's multiscale
+row; superseded by it if the chip returns.
+"""
+import json
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from real_time_helmet_detection_tpu.config import Config
+from real_time_helmet_detection_tpu.data import make_synthetic_voc
+from real_time_helmet_detection_tpu.evaluate import evaluate
+from real_time_helmet_detection_tpu.train import train
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "multiscale_full.json")
+root, save = "/tmp/scenes_calib", "/tmp/scenes_calib_ms_w"
+
+if not os.path.exists(os.path.join(root, "ImageSets")):
+    make_synthetic_voc(root, num_train=160, num_test=48,
+                       imsize=(256, 256), max_objects=10, seed=21,
+                       style="scenes")
+os.makedirs(os.path.join(save, "training_log"), exist_ok=True)
+base = dict(num_stack=1, hourglass_inch=32, num_cls=2, batch_size=4,
+            num_workers=2)
+cfg = Config(train_flag=True, data=root, save_path=save, end_epoch=60,
+             lr=1e-3, lr_milestone=[30, 54], imsize=None,
+             multiscale_flag=True, multiscale=[256, 384, 64],
+             ckpt_interval=5, keep_ckpt=2, print_interval=200, **base)
+t0 = time.time()
+train(cfg)
+m = evaluate(Config(train_flag=False, data=root, save_path=save,
+                    model_load=save + "/check_point_60", imsize=256,
+                    conf_th=0.05, topk=100, **base))
+rec = {"row": "multiscale_{256,320}_full60",
+       "held_out_mAP": round(float(m["map"]), 4),
+       "ap_hat": round(float(m["ap"].get(0, -1)), 4),
+       "ap_person": round(float(m["ap"].get(1, -1)), 4),
+       "base_row_mAP": 0.5305, "wall_s": round(time.time() - t0, 1)}
+with open(OUT, "w") as f:
+    json.dump(rec, f, indent=1)
+print(json.dumps(rec), flush=True)

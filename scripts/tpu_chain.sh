@@ -10,6 +10,8 @@
 #   setsid nohup bash scripts/tpu_chain.sh >> artifacts/r04/chain.log 2>&1 &
 set -u
 cd /root/repo
+# (scaffolding lives in scripts/tpu_chain_lib.sh)
+. "$(dirname "$0")/tpu_chain_lib.sh"
 export BENCH_SKIP_PROBE=1 GRAFT_ROUND=r04
 # Queued context: skip bench's pallas A/B — its timeout path exits the
 # process mid-remote-compile, which can wedge the device claim and hang
@@ -18,44 +20,12 @@ export BENCH_SKIP_PROBE=1 GRAFT_ROUND=r04
 export BENCH_PALLAS=0
 mkdir -p artifacts/r04/logs
 
-stamp() { date -u '+%Y-%m-%dT%H:%M:%SZ'; }
-
-commit_art() {
-  # index-lock races with the interactive session are retried, then
-  # dropped — the next periodic commit picks the files up.
-  for _ in 1 2 3; do
-    git add artifacts/r04 scaling.json 2>/dev/null \
-      && git commit -q -m "$1" 2>/dev/null && return 0
-    sleep 7
-  done
-  return 0
-}
-
-run_stage() { # run_stage <name> <cmd...>; periodic commit while it runs
-  local name=$1; shift
-  echo "$(stamp) stage $name START: $*"
-  "$@" >> "artifacts/r04/logs/$name.log" 2>&1 &
-  local pid=$!
-  while kill -0 "$pid" 2>/dev/null; do
-    sleep 60
-    if [ -n "$(git status --porcelain artifacts/r04 2>/dev/null)" ]; then
-      commit_art "r04 chain: $name incremental artifacts"
-    fi
-  done
-  wait "$pid"; local rc=$?
-  echo "$(stamp) stage $name DONE rc=$rc"
-  commit_art "r04 chain: $name artifacts (rc=$rc)"
-  return $rc
-}
 
 echo "$(stamp) chain start: waiting for the TPU claim (no-timeout waiter)"
 # Waiter: blocks indefinitely while the claim is wedged; a service-outage
 # probe exits nonzero on its own (UNAVAILABLE after the 25-55 min hang)
 # and is retried after a pause. Never killed from outside.
-until python -c "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d; print('claim clear:', d)"; do
-  echo "$(stamp) probe exited nonzero (outage signature); retrying in 120s"
-  sleep 120
-done
+wait_for_claim
 echo "$(stamp) TPU claim clear — firing the queued jobs"
 
 # 1. bench: headline JSON line -> BENCH_r04_local.json

@@ -10,35 +10,10 @@
 #   SWEEP_PID=<pid> setsid nohup bash scripts/tpu_chain2.sh >> artifacts/r04/chain.log 2>&1 &
 set -u
 cd /root/repo
+# (scaffolding lives in scripts/tpu_chain_lib.sh)
+. "$(dirname "$0")/tpu_chain_lib.sh"
 export BENCH_SKIP_PROBE=1 GRAFT_ROUND=r04 BENCH_PALLAS=0
 
-stamp() { date -u '+%Y-%m-%dT%H:%M:%SZ'; }
-
-commit_art() {
-  for _ in 1 2 3; do
-    git add artifacts/r04 scaling.json 2>/dev/null \
-      && git commit -q -m "$1" 2>/dev/null && return 0
-    sleep 7
-  done
-  return 0
-}
-
-run_stage() { # run_stage <name> <cmd...>; periodic commit while it runs
-  local name=$1; shift
-  echo "$(stamp) stage $name START: $*"
-  "$@" >> "artifacts/r04/logs/$name.log" 2>&1 &
-  local pid=$!
-  while kill -0 "$pid" 2>/dev/null; do
-    sleep 60
-    if [ -n "$(git status --porcelain artifacts/r04 2>/dev/null)" ]; then
-      commit_art "r04 chain: $name incremental artifacts"
-    fi
-  done
-  wait "$pid"; local rc=$?
-  echo "$(stamp) stage $name DONE rc=$rc"
-  commit_art "r04 chain: $name artifacts (rc=$rc)"
-  return $rc
-}
 
 if [ -n "${SWEEP_PID:-}" ]; then
   echo "$(stamp) chain2: waiting on sweep pid $SWEEP_PID"
@@ -50,10 +25,7 @@ fi
 # Re-establish claim health before queuing more stages (the sweep may
 # have died UNAVAILABLE with the service still down). Same no-timeout
 # waiter as part 1.
-until python -c "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d; print('claim clear:', d)"; do
-  echo "$(stamp) probe exited nonzero (outage signature); retrying in 120s"
-  sleep 120
-done
+wait_for_claim
 echo "$(stamp) chain2: TPU claim clear — resuming queued stages"
 
 run_stage mfu_breakdown python scripts/mfu_breakdown.py

@@ -11,35 +11,10 @@
 #   CHAIN2_PID=<pid> setsid nohup bash scripts/tpu_chain3.sh >> artifacts/r04/chain.log 2>&1 &
 set -u
 cd /root/repo
+# (scaffolding lives in scripts/tpu_chain_lib.sh)
+. "$(dirname "$0")/tpu_chain_lib.sh"
 export BENCH_SKIP_PROBE=1 GRAFT_ROUND=r04
 
-stamp() { date -u '+%Y-%m-%dT%H:%M:%SZ'; }
-
-commit_art() {
-  for _ in 1 2 3; do
-    git add artifacts/r04 scaling.json 2>/dev/null \
-      && git commit -q -m "$1" 2>/dev/null && return 0
-    sleep 7
-  done
-  return 0
-}
-
-run_stage() {
-  local name=$1; shift
-  echo "$(stamp) stage $name START: $*"
-  "$@" >> "artifacts/r04/logs/$name.log" 2>&1 &
-  local pid=$!
-  while kill -0 "$pid" 2>/dev/null; do
-    sleep 60
-    if [ -n "$(git status --porcelain artifacts/r04 2>/dev/null)" ]; then
-      commit_art "r04 chain: $name incremental artifacts"
-    fi
-  done
-  wait "$pid"; local rc=$?
-  echo "$(stamp) stage $name DONE rc=$rc"
-  commit_art "r04 chain: $name artifacts (rc=$rc)"
-  return $rc
-}
 
 if [ -n "${CHAIN2_PID:-}" ]; then
   echo "$(stamp) chain3: waiting on chain2 pid $CHAIN2_PID"
@@ -47,10 +22,7 @@ if [ -n "${CHAIN2_PID:-}" ]; then
   echo "$(stamp) chain3: chain2 exited"
 fi
 
-until python -c "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d; print('claim clear:', d)"; do
-  echo "$(stamp) probe exited nonzero (outage signature); retrying in 120s"
-  sleep 120
-done
+wait_for_claim
 echo "$(stamp) chain3: TPU claim clear"
 
 run_stage sweep python scripts/tpu_sweep.py

@@ -1,0 +1,45 @@
+# Shared scaffolding for the round TPU job chains (sourced by
+# scripts/tpu_chain*.sh). Keep the semantics aligned with CLAUDE.md's
+# claim-waiter rules: probes exit on their own (never timeout-killed),
+# artifacts commit incrementally so a mid-run wedge loses at most one
+# config.
+
+stamp() { date -u '+%Y-%m-%dT%H:%M:%SZ'; }
+
+commit_art() {
+  # index-lock races with the interactive session are retried, then
+  # dropped — the next periodic commit picks the files up.
+  for _ in 1 2 3; do
+    git add "artifacts/${GRAFT_ROUND:-r04}" scaling.json 2>/dev/null \
+      && git commit -q -m "$1" 2>/dev/null && return 0
+    sleep 7
+  done
+  return 0
+}
+
+run_stage() { # run_stage <name> <cmd...>; periodic commit while it runs
+  local name=$1; shift
+  echo "$(stamp) stage $name START: $*"
+  "$@" >> "artifacts/${GRAFT_ROUND:-r04}/logs/$name.log" 2>&1 &
+  local pid=$!
+  while kill -0 "$pid" 2>/dev/null; do
+    sleep 60
+    if [ -n "$(git status --porcelain "artifacts/${GRAFT_ROUND:-r04}" 2>/dev/null)" ]; then
+      commit_art "${GRAFT_ROUND:-r04} chain: $name incremental artifacts"
+    fi
+  done
+  wait "$pid"; local rc=$?
+  echo "$(stamp) stage $name DONE rc=$rc"
+  commit_art "${GRAFT_ROUND:-r04} chain: $name artifacts (rc=$rc)"
+  return $rc
+}
+
+wait_for_claim() {
+  # ONE no-timeout waiter: blocks while the claim is wedged; an outage
+  # probe exits nonzero on its own (UNAVAILABLE after the 25-55 min
+  # hang) and is retried after a pause. Never killed from outside.
+  until python -c "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d; print('claim clear:', d)"; do
+    echo "$(stamp) probe exited nonzero (outage signature); retrying in 120s"
+    sleep 120
+  done
+}

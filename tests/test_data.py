@@ -258,6 +258,34 @@ def test_scenes_fixture_is_hard_but_well_formed(tmp_path):
                     assert frac <= 0.6, "head %d buried under head %d" % (a, b)
 
 
+def test_scenes_fixture_helmeted_rate_knob(tmp_path):
+    """`helmeted_rate` steers the class mix and head_div_range the head
+    scales — the two knobs the in-band overfit gate depends on
+    (artifacts/r04/calibration). The 0.72 default's SHWD-like mix is
+    pinned by test_scenes_fixture_is_hard_but_well_formed above."""
+    import numpy as np
+
+    from real_time_helmet_detection_tpu.data import make_synthetic_voc
+    from real_time_helmet_detection_tpu.data.voc import VOCDataset
+
+    root = make_synthetic_voc(str(tmp_path / "bal"), num_train=40,
+                              num_test=2, imsize=(64, 64), max_objects=3,
+                              seed=3, style="scenes",
+                              head_div_range=(5.0, 2.0), helmeted_rate=0.5)
+    ds = VOCDataset(root, "trainval")
+    counts, sizes = {0: 0, 1: 0}, []
+    for i in range(len(ds)):
+        _, boxes, labels, _ = ds[i]
+        for b, l in zip(boxes, labels):
+            counts[int(l)] += 1
+            sizes.append(max(b[2] - b[0], b[3] - b[1]))
+    total = counts[0] + counts[1]
+    # balanced mix (binomial noise over ~80 draws), every head resolvable
+    # at stride 4 on the 64^2 canvas
+    assert 0.33 <= counts[0] / total <= 0.67, counts
+    assert np.asarray(sizes).min() >= 10.0, min(sizes)
+
+
 def test_scenes_fixture_rejects_unknown_style(tmp_path):
     import pytest as _pytest
 
