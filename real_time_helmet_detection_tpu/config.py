@@ -155,9 +155,10 @@ class Config:
     # wedge mid-run; the reference has no failure detection at all.
     ema_decay: float = 0.0        # keep an exponential moving average of
     # the params inside the jitted step (0 disables); a capability the
-    # reference lacks. Helps only when decay matches the training budget:
-    # the r3 calibration (256^2 scenes, 0.998) measured -3.2 mAP vs raw
-    # weights, so treat it as an opt-in lever to validate per run.
+    # reference lacks. Helps only when decay matches the training budget
+    # (measured both ways on the same 256^2 setup: 0.998 -> -3.2 mAP,
+    # 0.99 -> +0.45; artifacts/r04/README.md): pick the decay so the
+    # averaging window fits inside the final-LR phase.
     ema_eval: bool = False        # evaluate/demo/export with the EMA
     # weights from the checkpoint (requires a --ema-decay training run)
     prewarm: bool = False         # compile every multiscale bucket before

@@ -55,9 +55,11 @@ class TrainState(struct.PyTreeNode):
     exponential moving average of `params`, updated inside the jitted
     step; `--ema-eval` evaluates with it. A capability the reference
     lacks. Whether EMA helps depends on the decay-vs-training-budget
-    match: at the r3 calibration budget (256^2 scenes, decay 0.998) it
-    scored -3.2 mAP vs the raw weights (artifacts/r03/README.md), so it
-    is an opt-in lever, not a default.
+    match — measured both ways on the same 2400-step 256^2 setup:
+    decay 0.998 (window reaching back across the final LR drop) scored
+    -3.2 mAP, decay 0.99 (window inside the final-LR phase) +0.45
+    (artifacts/r04/README.md). Opt-in lever: pick decay so the
+    averaging window fits inside the final-LR phase.
     """
     step: jax.Array
     params: Any
