@@ -1,0 +1,106 @@
+"""Calibrate SHORTER overfit-gate recipes (VERDICT r4 next #6).
+
+The full suite costs ~47 min cold on this 1-core box, dominated by the two
+overfit gates (tests/test_evaluate.py): blocks @200 epochs and scenes @300
+epochs, ~9 min each. This probe reruns both recipes at half budget (and the
+scenes one also at 2/3) with LR milestones scaled to the run, recording the
+loss drop and eval mAP, so the suite can adopt the shortest recipe that
+still sits mid-band (discriminative: a regression moves it measurably).
+
+Run: python artifacts/r05/calibration/gate_shorten_probe.py
+Writes gate_shorten_probe.json next to itself, flushing per row.
+"""
+
+import json
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "gate_shorten_probe.json")
+
+
+def run_gate(style, epochs, workdir):
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.data import make_synthetic_voc
+    from real_time_helmet_detection_tpu.evaluate import evaluate
+    from real_time_helmet_detection_tpu.train import train
+
+    root = os.path.join(workdir, "voc")
+    save = os.path.join(workdir, "w")
+    for d in (root, save):
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+    if style == "scenes":
+        make_synthetic_voc(root, num_train=6, num_test=2, imsize=(64, 64),
+                           max_objects=3, seed=1, style="scenes",
+                           head_div_range=(5.0, 2.0), helmeted_rate=0.5)
+    else:
+        make_synthetic_voc(root, num_train=6, num_test=4, imsize=(96, 72),
+                           seed=1)
+    shutil.copy(os.path.join(root, "ImageSets", "Main", "trainval.txt"),
+                os.path.join(root, "ImageSets", "Main", "test.txt"))
+    os.makedirs(os.path.join(save, "training_log"), exist_ok=True)
+
+    def cfg(**kw):
+        base = dict(num_stack=2, hourglass_inch=16, num_cls=2, topk=10,
+                    conf_th=0.1, nms_th=0.5, imsize=64, batch_size=2,
+                    num_workers=2, print_interval=1000)
+        base.update(kw)
+        return Config(**base)
+
+    t0 = time.time()
+    tcfg = cfg(train_flag=True, data=root, save_path=save, end_epoch=epochs,
+               lr=1e-2, lr_milestone=[int(epochs * 0.5), int(epochs * 0.9)],
+               batch_size=2, imsize=None, multiscale_flag=True,
+               multiscale=[64, 128, 64])
+    train(tcfg)
+    train_s = time.time() - t0
+
+    ckpt = os.path.join(save, "check_point_%d" % epochs)
+    with open(os.path.join(ckpt, "loss_log.json")) as f:
+        log = json.load(f)
+    first = float(np.mean(log["total"][:10]))
+    last = float(np.mean(log["total"][-10:]))
+
+    m = evaluate(cfg(train_flag=False, data=root, save_path=save,
+                     model_load=ckpt, imsize=64))
+    return {"style": style, "epochs": epochs,
+            "loss_first": round(first, 3), "loss_last": round(last, 3),
+            "loss_drop_x": round(first / max(last, 1e-9), 1),
+            "map": round(float(m["map"]), 4),
+            "ap": {str(k): round(float(v), 4) for k, v in m["ap"].items()},
+            "train_s": round(train_s, 1),
+            "wall_s": round(time.time() - t0, 1)}
+
+
+def main():
+    results = {}
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            results = json.load(f)
+    probes = [("blocks", 100), ("scenes", 150), ("scenes", 200),
+              ("blocks", 80)]
+    for style, epochs in probes:
+        key = "%s_%d" % (style, epochs)
+        if key in results:
+            continue
+        print("[probe] %s ..." % key, flush=True)
+        results[key] = run_gate(style, epochs, "/tmp/gate_probe_%s" % key)
+        print("[probe] %s -> %s" % (key, results[key]), flush=True)
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Host streaming-loader throughput (VERDICT r4 weak #6).
+
+The default input path is the thread-based `BatchLoader` (JPEG decode +
+matrix-fused augment + GT encode + normalize on host). It is GIL-bound for
+the numpy stages, which is moot under `--device-augment`/`--cache-device`
+(the measured r2/r4 training paths) but is the input-bound risk on a real
+multi-host pod at 512^2 (SURVEY.md §3.1). This bench puts a measured
+img/s-per-host-core number on that risk:
+
+  host_encoded  full host path: decode+augment+encode+normalize (f32 wire)
+  host_raw      --device-augment wire: decode+augment only (uint8 wire)
+
+vs the chip's measured consumption of 435 img/s at the flagship config
+(artifacts/r04/BENCH_r04_local.json). Writes host_loader_bench.json next
+to itself. Run: python artifacts/r05/calibration/host_loader_bench.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "host_loader_bench.json")
+DATA = "/tmp/loader_bench_voc"
+IMSIZE = 512
+N_IMGS = 96
+BATCH = 16
+
+
+def main():
+    from real_time_helmet_detection_tpu.data import make_synthetic_voc
+    from real_time_helmet_detection_tpu.data.pipeline import BatchLoader
+    from real_time_helmet_detection_tpu.data.voc import VOCDataset
+    from real_time_helmet_detection_tpu.data.augment import TrainAugmentor
+
+    if not os.path.isdir(os.path.join(DATA, "JPEGImages")):
+        print("[loader_bench] generating %d x %d^2 scenes images..."
+              % (N_IMGS, IMSIZE), flush=True)
+        make_synthetic_voc(DATA, num_train=N_IMGS, num_test=2,
+                           imsize=(IMSIZE, IMSIZE), max_objects=12, seed=3,
+                           style="scenes")
+
+    dataset = VOCDataset(DATA, image_set="trainval")
+    results = {"imsize": IMSIZE, "n_images": len(dataset), "batch": BATCH,
+               "host_cores": os.cpu_count(),
+               "chip_consumption_img_s": 435.1,
+               "chip_consumption_src": "artifacts/r04/BENCH_r04_local.json",
+               "modes": {}}
+
+    for mode, raw in (("host_encoded", False), ("host_raw", True)):
+        aug = TrainAugmentor(multiscale_flag=False,
+                             multiscale=[IMSIZE, IMSIZE, 64],
+                             rng=np.random.default_rng(0))
+        loader = BatchLoader(dataset, aug, BATCH, num_workers=4,
+                             prefetch=2, raw=raw)
+        # warm one epoch (page cache, pool spin-up), then time one
+        for _ in loader:
+            pass
+        t0 = time.time()
+        n = 0
+        for b in loader:
+            n += b.image.shape[0]
+        dt = time.time() - t0
+        results["modes"][mode] = {
+            "img_per_sec": round(n / dt, 2),
+            "sec_per_batch": round(dt / max(n // BATCH, 1), 3),
+            "images": n, "wall_s": round(dt, 1)}
+        print("[loader_bench] %s: %.1f img/s" % (mode, n / dt), flush=True)
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+
+    enc = results["modes"]["host_encoded"]["img_per_sec"]
+    results["hosts_per_chip_at_flagship"] = round(435.1 / enc, 2)
+    with open(OUT, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

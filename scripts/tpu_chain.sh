@@ -53,7 +53,7 @@ if run_stage scaling_anchor python scaling.py --tpu --devices 1; then
   # guard the copy on success: a failed --tpu run would otherwise re-commit
   # the pre-existing CPU-row scaling.json as the "anchor" (review finding)
   cp scaling.json artifacts/r04/scaling_anchor.json
-  commit_art "r04 chain: scaling hardware anchor"
+  commit_scaling "r04 chain: scaling hardware anchor"
 fi
 
 # 5. C++ runner FPS early (fresh-init weights: FPS valid, detections noise)

@@ -32,7 +32,7 @@ run_stage mfu_breakdown python scripts/mfu_breakdown.py
 
 if run_stage scaling_anchor python scaling.py --tpu --devices 1; then
   cp scaling.json artifacts/r04/scaling_anchor.json
-  commit_art "r04 chain: scaling hardware anchor"
+  commit_scaling "r04 chain: scaling hardware anchor"
 fi
 
 run_stage runner_early python scripts/runner_drive.py

@@ -6,15 +6,25 @@
 
 stamp() { date -u '+%Y-%m-%dT%H:%M:%SZ'; }
 
-commit_art() {
-  # index-lock races with the interactive session are retried, then
-  # dropped — the next periodic commit picks the files up.
+# index-lock races with the interactive session are retried, then
+# dropped — the next periodic commit picks the files up.
+_commit_retry() { # _commit_retry <msg> <path>...
+  local msg=$1; shift
   for _ in 1 2 3; do
-    git add "artifacts/${GRAFT_ROUND:-r04}" scaling.json 2>/dev/null \
-      && git commit -q -m "$1" 2>/dev/null && return 0
+    git add "$@" 2>/dev/null \
+      && git commit -q -m "$msg" 2>/dev/null && return 0
     sleep 7
   done
   return 0
+}
+
+# Stages ONLY the round's artifact dir: scaling.json is staged explicitly
+# by the scaling_anchor stage (commit_scaling), so unrelated concurrent
+# edits to it can't be swept into an arbitrary stage commit (advisor r4).
+commit_art() { _commit_retry "$1" "artifacts/${GRAFT_ROUND:-r04}"; }
+
+commit_scaling() { # scaling_anchor stage only: stage scaling.json too
+  _commit_retry "$1" "artifacts/${GRAFT_ROUND:-r04}" scaling.json
 }
 
 run_stage() { # run_stage <name> <cmd...>; periodic commit while it runs

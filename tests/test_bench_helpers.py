@@ -92,6 +92,83 @@ def test_sweep_merge_prior_rejects_other_platform():
         sweep.merge_prior(dict(fresh), prior, only={"train"})
 
 
+def _write_bench_artifact(root, round_name, rec, fname=None):
+    d = os.path.join(root, "artifacts", round_name)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, fname or ("BENCH_%s_local.json" % round_name))
+    import json
+    with open(path, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def test_find_last_tpu_result_picks_newest_tpu_line(tmp_path):
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r03", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1100.0,
+        "mfu_train": 0.47})
+    newest = _write_bench_artifact(root, "r04", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1207.7,
+        "vs_baseline": 12.077, "train_img_per_sec_chip": 435.1,
+        "mfu_train": 0.5278, "latency_ms_b1": 1.477})
+    # adversarial mtimes: the OLDER round gets the NEWER mtime (fresh-clone
+    # checkout order is arbitrary); round number must win, not mtime
+    now = os.path.getmtime(newest)
+    os.utime(os.path.join(root, "artifacts", "r03",
+                          "BENCH_r03_local.json"), (now + 60, now + 60))
+    got = bench.find_last_tpu_result(root)
+    assert got is not None
+    assert got["value"] == 1207.7
+    assert got["mfu_train"] == 0.5278
+    assert got["train_img_per_sec_chip"] == 435.1
+    assert got["path"].endswith("r04/BENCH_r04_local.json")
+    # these tmp artifacts are not in git: no commit provenance claimed
+    assert got["committed_at"] is None
+    assert "NOT yet committed" in got["note"]
+    assert got["file_mtime_utc"]
+
+
+def test_find_last_tpu_result_skips_cpu_and_malformed(tmp_path):
+    root = str(tmp_path)
+    # a CPU fallback line must never be surfaced as on-chip evidence
+    _write_bench_artifact(root, "r02", {"platform": "cpu", "value": 18.3})
+    bad = _write_bench_artifact(root, "r03", {"platform": "tpu"})
+    with open(bad, "w") as f:
+        f.write("{not json")
+    assert bench.find_last_tpu_result(root) is None
+    # and an empty tree returns None rather than raising
+    assert bench.find_last_tpu_result(str(tmp_path / "nowhere")) is None
+
+
+def test_find_last_tpu_result_real_repo_picks_highest_round():
+    # the repo's own committed artifacts must be discoverable, and the
+    # SELECTED one must be the highest-round on-chip line present (r02 also
+    # clears any static value floor, so pin the round, not a threshold)
+    import glob
+    import json
+    import re
+    got = bench.find_last_tpu_result(REPO)
+    assert got is not None
+    assert got["value"] >= 1000.0  # r4: 1207.7 img/s @512^2
+    rounds = []
+    for p in glob.glob(os.path.join(REPO, "artifacts", "*",
+                                    "BENCH_*_local.json")):
+        try:
+            with open(p) as f:
+                rec = json.loads(f.read().strip().splitlines()[-1])
+        except (OSError, json.JSONDecodeError, IndexError):
+            continue
+        if rec.get("platform") == "tpu":
+            m = re.search(r"r(\d+)", os.path.basename(os.path.dirname(p)))
+            rounds.append(int(m.group(1)) if m else -1)
+    want = max(rounds)
+    m = re.search(r"r(\d+)", got["path"])
+    assert m and int(m.group(1)) == want, (got["path"], rounds)
+    # committed artifacts carry git provenance (the working tree may also
+    # hold a not-yet-committed newer one; both labels are legitimate here)
+    assert got["committed_at"] or "NOT yet committed" in got["note"]
+
+
 def test_sweep_section_keys_cover_all_result_lists():
     sweep = _load_sweep()
     assert set(sweep.SECTION_KEYS.values()) == {
