@@ -29,7 +29,7 @@ OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "gate_shorten_probe.json")
 
 
-def run_gate(style, epochs, workdir):
+def run_gate(style, epochs, workdir, ckpt_interval=1):
     from real_time_helmet_detection_tpu.config import Config
     from real_time_helmet_detection_tpu.data import make_synthetic_voc
     from real_time_helmet_detection_tpu.evaluate import evaluate
@@ -62,7 +62,7 @@ def run_gate(style, epochs, workdir):
     tcfg = cfg(train_flag=True, data=root, save_path=save, end_epoch=epochs,
                lr=1e-2, lr_milestone=[int(epochs * 0.5), int(epochs * 0.9)],
                batch_size=2, imsize=None, multiscale_flag=True,
-               multiscale=[64, 128, 64])
+               multiscale=[64, 128, 64], ckpt_interval=ckpt_interval)
     train(tcfg)
     train_s = time.time() - t0
 
@@ -88,14 +88,25 @@ def main():
     if os.path.exists(OUT):
         with open(OUT) as f:
             results = json.load(f)
-    probes = [("blocks", 100), ("scenes", 150), ("scenes", 200),
-              ("blocks", 80)]
-    for style, epochs in probes:
-        key = "%s_%d" % (style, epochs)
+    # Epoch-reduction rows came back OUT of the discriminative band
+    # (scenes_150 mAP 0.14, scenes_200 0.02 — the recipe genuinely needs
+    # the full 300 epochs to converge past the LR drops). The wall-clock
+    # hog is elsewhere: ckpt_interval defaults to 1, so the gates pay an
+    # orbax sync checkpoint write EVERY epoch. The *_ckend rows keep the
+    # calibrated budgets exactly (identical training math — checkpoint
+    # cadence does not consume RNG or touch weights) and write only the
+    # final checkpoint; they must REPRODUCE the calibrated mAPs
+    # (blocks@200: 0.39, scenes@300: 0.5833) at a fraction of the wall.
+    probes = [("blocks", 100, 1), ("scenes", 150, 1), ("scenes", 200, 1),
+              ("blocks", 80, 1),
+              ("blocks", 200, 200), ("scenes", 300, 300)]
+    for style, epochs, ck in probes:
+        key = "%s_%d" % (style, epochs) + ("_ckend" if ck != 1 else "")
         if key in results:
             continue
         print("[probe] %s ..." % key, flush=True)
-        results[key] = run_gate(style, epochs, "/tmp/gate_probe_%s" % key)
+        results[key] = run_gate(style, epochs, "/tmp/gate_probe_%s" % key,
+                                ckpt_interval=ck)
         print("[probe] %s -> %s" % (key, results[key]), flush=True)
         with open(OUT, "w") as f:
             json.dump(results, f, indent=1)
