@@ -29,7 +29,7 @@ OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "gate_shorten_probe.json")
 
 
-def run_gate(style, epochs, workdir, ckpt_interval=1):
+def run_gate(style, epochs, workdir, ckpt_interval=1, scale_milestones=True):
     from real_time_helmet_detection_tpu.config import Config
     from real_time_helmet_detection_tpu.data import make_synthetic_voc
     from real_time_helmet_detection_tpu.evaluate import evaluate
@@ -59,10 +59,15 @@ def run_gate(style, epochs, workdir, ckpt_interval=1):
         return Config(**base)
 
     t0 = time.time()
-    tcfg = cfg(train_flag=True, data=root, save_path=save, end_epoch=epochs,
-               lr=1e-2, lr_milestone=[int(epochs * 0.5), int(epochs * 0.9)],
-               batch_size=2, imsize=None, multiscale_flag=True,
-               multiscale=[64, 128, 64], ckpt_interval=ckpt_interval)
+    kw = dict(train_flag=True, data=root, save_path=save, end_epoch=epochs,
+              lr=1e-2, batch_size=2, imsize=None, multiscale_flag=True,
+              multiscale=[64, 128, 64], ckpt_interval=ckpt_interval)
+    if scale_milestones:
+        # the scenes gate's recipe (milestones scale with the budget);
+        # scale_milestones=False keeps the Config default [50, 90] — the
+        # blocks gate's EXACT recipe (tests/test_evaluate.py sets none)
+        kw["lr_milestone"] = [int(epochs * 0.5), int(epochs * 0.9)]
+    tcfg = cfg(**kw)
     train(tcfg)
     train_s = time.time() - t0
 
@@ -93,20 +98,31 @@ def main():
     # the full 300 epochs to converge past the LR drops). The wall-clock
     # hog is elsewhere: ckpt_interval defaults to 1, so the gates pay an
     # orbax sync checkpoint write EVERY epoch. The *_ckend rows keep the
-    # calibrated budgets exactly (identical training math — checkpoint
-    # cadence does not consume RNG or touch weights) and write only the
-    # final checkpoint; they must REPRODUCE the calibrated mAPs
-    # (blocks@200: 0.39, scenes@300: 0.5833) at a fraction of the wall.
-    probes = [("blocks", 100, 1), ("scenes", 150, 1), ("scenes", 200, 1),
-              ("blocks", 80, 1),
-              ("blocks", 200, 200), ("scenes", 300, 300)]
-    for style, epochs, ck in probes:
-        key = "%s_%d" % (style, epochs) + ("_ckend" if ck != 1 else "")
+    # training budget and write only the final checkpoint (cadence does
+    # not consume RNG or touch weights). scenes_300_ckend uses the scenes
+    # gate's exact recipe and must REPRODUCE its calibrated 0.5833
+    # bit-for-bit; blocks_200_ckend uses scaled milestones [100, 180]
+    # (NOT the blocks gate's default [50, 90] — its 0.4257 is a different
+    # recipe, not a reproduction target); blocks_200_ckend_defms is the
+    # blocks gate's EXACT recipe (default milestones) and must reproduce
+    # its calibrated ~0.39 (review finding: the inertness claim needs a
+    # probe of the recipe the test actually runs).
+    probes = [("blocks", 100, 1, True), ("scenes", 150, 1, True),
+              ("scenes", 200, 1, True), ("blocks", 80, 1, True),
+              ("blocks", 200, 200, True), ("scenes", 300, 300, True),
+              ("blocks", 200, 200, False),
+              # interval=1 twin of the exact blocks recipe: must equal
+              # blocks_200_ckend_defms bit-for-bit (cadence inertness
+              # proven on the recipe the test actually runs)
+              ("blocks", 200, 1, False)]
+    for style, epochs, ck, scale_ms in probes:
+        key = ("%s_%d" % (style, epochs) + ("_ckend" if ck != 1 else "")
+               + ("" if scale_ms else "_defms"))
         if key in results:
             continue
         print("[probe] %s ..." % key, flush=True)
         results[key] = run_gate(style, epochs, "/tmp/gate_probe_%s" % key,
-                                ckpt_interval=ck)
+                                ckpt_interval=ck, scale_milestones=scale_ms)
         print("[probe] %s -> %s" % (key, results[key]), flush=True)
         with open(OUT, "w") as f:
             json.dump(results, f, indent=1)

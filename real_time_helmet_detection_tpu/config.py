@@ -172,6 +172,9 @@ class Config:
     # client cannot be rebuilt in-process, so a dead backend aborts with
     # advice to restart with --model-load. The reference's only recovery
     # is a manual restart (its train.py:190).
+    resume_backoff_s: float = 15.0  # auto-resume backoff base: attempt k
+    # sleeps min(300, k * this) before probing the device (tests use a
+    # near-zero value; a real transport blip needs the full pause)
     fault_inject: str = ""        # debug: "EPOCH:ITER" raises one synthetic
     # transient backend error at that step, to exercise --auto-resume
     save_path: str = "./WEIGHTS/"

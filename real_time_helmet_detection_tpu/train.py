@@ -1064,7 +1064,7 @@ def train(cfg: Config) -> TrainState:
                         and is_transient_backend_error(e)):
                     raise
                 resume_attempts += 1
-                wait = min(300.0, 15.0 * resume_attempts)
+                wait = min(300.0, cfg.resume_backoff_s * resume_attempts)
                 print("%s: transient backend failure in epoch %d (%s: %s); "
                       "recovery %d/%d in %.0fs"
                       % (timestamp(), epoch, type(e).__name__,

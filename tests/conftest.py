@@ -19,13 +19,23 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compile cache: the suite is compile-dominated (recursive
+# hourglass at several configs/shapes); warm runs drop from ~10min to ~2min.
+# Set as ENV VARS (jax reads both natively) rather than jax.config.update
+# so every subprocess a test spawns — distributed/eval workers, the CLI
+# runs, the multichip dryrun — inherits the cache with zero per-file
+# plumbing. Unlike JAX_PLATFORMS (snapshotted by the sitecustomize jax
+# import before we run), these are read lazily at cache use.
+# NOTE the cache is machine-specific: XLA:CPU AOT results bake in host CPU
+# features, and entries from a different box make loads fail or crash
+# (observed: a stale cache from the earlier multi-core image broke the
+# 4-process rendezvous) — hence gitignored, never committed.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "build",
+                 "jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-
-# Persistent XLA compile cache: the suite is compile-dominated (recursive
-# hourglass at several configs/shapes); warm runs drop from ~10min to ~2min.
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(__file__), "..", "build",
-                               "jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

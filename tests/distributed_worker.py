@@ -33,6 +33,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# The persistent compile cache arrives via JAX_COMPILATION_CACHE_DIR,
+# inherited from conftest.py's environment — each worker is a fresh
+# process, and without it every multi-process test recompiles the
+# model/train-step from scratch per rank.
 
 import numpy as np  # noqa: E402
 

@@ -24,6 +24,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# compile cache via inherited JAX_COMPILATION_CACHE_DIR (conftest.py)
 
 from real_time_helmet_detection_tpu.config import Config  # noqa: E402
 from real_time_helmet_detection_tpu.evaluate import evaluate  # noqa: E402

@@ -28,7 +28,10 @@ def _cfg(fixture_root, save, **kw):
     base = dict(train_flag=True, num_stack=1, hourglass_inch=16, num_cls=2,
                 imsize=64, batch_size=2, end_epoch=3, ckpt_interval=1,
                 print_interval=1, num_workers=0, data=fixture_root,
-                save_path=save, hang_warn_seconds=0)
+                save_path=save, hang_warn_seconds=0,
+                # injected faults need no real-transport pause; the backoff
+                # path itself is still exercised
+                resume_backoff_s=0.2)
     base.update(kw)
     return Config(**base)
 

@@ -17,6 +17,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def run_cli(args, timeout=560):
+    # the persistent compile cache arrives via JAX_COMPILATION_CACHE_DIR,
+    # inherited from conftest.py's environment: without it the 4-stage
+    # test pays a from-scratch model compile per stage
     return subprocess.run(
         [sys.executable, "-u", os.path.join(REPO, "main.py"),
          "--platform", "cpu"] + args,

@@ -85,6 +85,7 @@ def _single_process_reference(global_batch: int):
             float(np.asarray(jax.tree.leaves(state.params)[0]).ravel()[0]))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("ndev_local", [1, 2])
 def test_two_process_train_step_matches_single(tmp_path, ndev_local):
     """2 processes x ndev_local devices: ndev_local=2 exercises the real
@@ -101,6 +102,7 @@ def test_two_process_train_step_matches_single(tmp_path, ndev_local):
     assert multi["param0"] == pytest.approx(single_p0, rel=1e-4, abs=1e-6)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_32_devices():
     """The driver-facing multichip dryrun must stay green at a pod-ish 32
     virtual devices with its (data=8, spatial=4) mesh (round-2 verdict #5).
@@ -117,6 +119,7 @@ def test_dryrun_multichip_32_devices():
     assert "cached-gather step" in out.stdout
 
 
+@pytest.mark.slow
 def test_two_process_2d_mesh_matches_single(tmp_path):
     """2 processes x 2 devices on a (data=2, spatial=2) mesh must agree
     with the plain single-device run. Topology note: make_mesh keeps the
@@ -136,6 +139,7 @@ def test_two_process_2d_mesh_matches_single(tmp_path):
                                                  abs=1e-6)
 
 
+@pytest.mark.slow
 def test_four_process_train_step_matches_single(tmp_path):
     """4 processes x 2 devices = an 8-device global mesh across 4 host
     boundaries (round-2 verdict #5: scale multi-host evidence toward pod
@@ -156,6 +160,7 @@ EVAL_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "eval_worker.py")
 
 
+@pytest.mark.slow
 def test_two_process_eval_matches_single(tmp_path):
     """Multi-host evaluation (round-3 verdict #5): 2 processes each score
     their rank shard of the test split, allgather fixed-shape detection

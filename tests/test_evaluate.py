@@ -171,9 +171,21 @@ def test_overfit_learns(tmp_path):
     mAP clears a floor (judge r1 weak #5 — `isfinite` alone would pass a
     silent numerics regression).
 
-    Calibration (CPU, seed-deterministic): 200 epochs @ lr 1e-2 reaches
-    total loss ~2 (from ~88, 40x) and train-split mAP 0.39; bars are set
-    with wide margin (8x, 0.15) so only a real regression trips them."""
+    Calibration (CPU, seed-deterministic, re-measured r5 with this exact
+    recipe — artifacts/r05/calibration/gate_shorten_probe.json row
+    blocks_200_ckend_defms): 200 epochs @ lr 1e-2, default milestones
+    [50, 90], reaches total loss 2.44 (from 39.8, 16.3x) and train-split
+    mAP 0.2338 (both classes ~0.23). Bars: loss 8x (2.0x margin), mAP
+    floor 0.15 (1.56x margin) — a collapse or silent numerics regression
+    trips them; epoch-budget cuts do too (100 ep -> 0.15, 80 ep -> 0.08).
+
+    ckpt_interval=end_epoch: the gate's wall-clock was dominated by the
+    per-epoch orbax sync write (default interval 1 -> 200 blocking
+    saves), not by training compute. Checkpoint cadence is inert to the
+    training math (no RNG use, no state mutation), probed on BOTH gate
+    recipes: this one (blocks_200_defms vs blocks_200_ckend_defms) and
+    the scenes gate (which reproduces its calibrated 0.5833 bit-for-bit
+    with interval=end). ~540s -> ~200s on the r5 1-core box."""
     import json
     import shutil
 
@@ -194,7 +206,7 @@ def test_overfit_learns(tmp_path):
     cfg = tiny_cfg(train_flag=True, data=root, save_path=save,
                    end_epoch=epochs, lr=1e-2, batch_size=2, imsize=None,
                    multiscale_flag=True, multiscale=[64, 128, 64],
-                   print_interval=1000)
+                   print_interval=1000, ckpt_interval=epochs)
     train(cfg)
 
     ckpt = os.path.join(save, "check_point_%d" % epochs)
@@ -227,7 +239,12 @@ def test_overfit_learns_scenes(tmp_path):
       the person class has too few examples and its AP pins to 0;
     - LR milestones must scale with the run (the reference's absolute
       [50, 90] kills the LR at epoch 90 and every longer budget stalls
-      at hm-loss ~3-4 -> mAP < 0.08)."""
+      at hm-loss ~3-4 -> mAP < 0.08);
+    - the 300-epoch budget is REAL, not slack: at 150 epochs mAP falls
+      to 0.14 and at 200 to 0.02 (gate_shorten_probe.json) — shortening
+      must come from checkpoint cadence (ckpt_interval=end_epoch, which
+      reproduced this row's 0.5833 bit-for-bit at half the wall), never
+      from the training budget."""
     import json
     import shutil
 
@@ -248,7 +265,8 @@ def test_overfit_learns_scenes(tmp_path):
                    end_epoch=epochs, lr=1e-2,
                    lr_milestone=[int(epochs * 0.5), int(epochs * 0.9)],
                    batch_size=2, imsize=None, multiscale_flag=True,
-                   multiscale=[64, 128, 64], print_interval=1000)
+                   multiscale=[64, 128, 64], print_interval=1000,
+                   ckpt_interval=epochs)
     train(cfg)
 
     ckpt = os.path.join(save, "check_point_%d" % epochs)
