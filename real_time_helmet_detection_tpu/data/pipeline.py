@@ -141,6 +141,16 @@ class BatchLoader:
     The per-host shard is `indices[rank::world_size]` after a (seed, epoch)
     keyed permutation — the `DistributedSampler` equivalent (ref
     train.py:54, 67). `drop_last=True` for training keeps shapes static.
+
+    Scaling note (measured r5, artifacts/r05/calibration/
+    host_loader_bench.json): this thread-based loader is GIL-bound for
+    the numpy stages and delivers ~49 img/s per host core at 512^2 on
+    the full path (decode+augment+encode+normalize) and ~91 img/s on the
+    raw uint8 wire (`raw=True`, the --device-augment input mode) — vs a
+    chip consuming 435 img/s at the flagship train config. On a real
+    pod, budget ~9 host cores per chip for the full host path, ~5 with
+    --device-augment, or use --cache-device (decode once, gather batches
+    on-device) to take the host off the steady-state path entirely.
     """
 
     def __init__(self, dataset, augmentor, batch_size: int,
