@@ -1,0 +1,90 @@
+"""Best composed recipe at the r3/r4 CPU calibration point (VERDICT r4 #9).
+
+The r4 lever matrix (scenes 256^2, 160/48 split, inch32, 60 epochs, CPU)
+measured every single lever and one composition: multiscale+soft-NMS
+0.5881 (+5.8 over base 0.5305). The biggest lever, num_stack=2 (+21.3,
+0.7438 — r3), has never been composed with anything. This run trains
+stack2 + two-bucket multiscale {256, 320} on the identical setup and
+evaluates the same weights under hard NMS and soft-NMS, completing the
+composition story:
+
+  stack2+multiscale        (training + hard-NMS eval)
+  stack2+multiscale+soft   (same weights, soft-NMS eval)
+
+Directly comparable to every committed row (same fixture seed 21, same
+budget, same milestones [30, 54]). Outage insurance for the 512^2 TPU
+quality matrix's composed rows (scripts/quality_matrix.py now trains the
+same composition at flagship scale); superseded by those if the chip
+returns. ~6 h on the 1-core box (stack1 multiscale was 2.9 h, stack2
+roughly doubles the model).
+
+Run: python artifacts/r05/calibration/stack2_composed.py
+Writes stack2_composed.json next to itself after each eval.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from real_time_helmet_detection_tpu.config import Config
+from real_time_helmet_detection_tpu.data import make_synthetic_voc
+from real_time_helmet_detection_tpu.evaluate import evaluate
+from real_time_helmet_detection_tpu.train import train
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "stack2_composed.json")
+root, save = "/tmp/scenes_calib", "/tmp/scenes_calib_s2ms_w"
+
+if not os.path.exists(os.path.join(root, "ImageSets")):
+    make_synthetic_voc(root, num_train=160, num_test=48,
+                       imsize=(256, 256), max_objects=10, seed=21,
+                       style="scenes")
+os.makedirs(os.path.join(save, "training_log"), exist_ok=True)
+base = dict(num_stack=2, hourglass_inch=32, num_cls=2, batch_size=4,
+            num_workers=2)
+
+results = {}
+if os.path.exists(OUT):
+    with open(OUT) as f:
+        results = json.load(f)
+
+
+def flush():
+    with open(OUT, "w") as f:
+        json.dump(results, f, indent=1)
+
+
+ckpt = os.path.join(save, "check_point_60")
+if not os.path.isdir(ckpt):
+    cfg = Config(train_flag=True, data=root, save_path=save, end_epoch=60,
+                 lr=1e-3, lr_milestone=[30, 54], imsize=None,
+                 multiscale_flag=True, multiscale=[256, 384, 64],
+                 ckpt_interval=5, keep_ckpt=2, print_interval=200, **base)
+    t0 = time.time()
+    train(cfg)
+    results["train_wall_s"] = round(time.time() - t0, 1)
+    flush()
+
+for row, nms in (("stack2+multiscale", "nms"),
+                 ("stack2+multiscale+soft", "soft-nms")):
+    if row in results:
+        continue
+    m = evaluate(Config(train_flag=False, data=root, save_path=save,
+                        model_load=ckpt, imsize=256, conf_th=0.05,
+                        topk=100, nms=nms, **base))
+    results[row] = {
+        "held_out_mAP": round(float(m["map"]), 4),
+        "ap_hat": round(float(m["ap"].get(0, -1)), 4),
+        "ap_person": round(float(m["ap"].get(1, -1)), 4),
+        "base_row_mAP": 0.5305, "stack2_row_mAP": 0.7438,
+        "multiscale_soft_row_mAP": 0.5881}
+    print(json.dumps({row: results[row]}), flush=True)
+    flush()
+
+print(json.dumps(results))

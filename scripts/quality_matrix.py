@@ -20,6 +20,9 @@ class imbalance) and records held-out mAP for each lever:
   base+pool5  same weights, 5x5 peak window           (eval only)
   stack2      num_stack=2                             (1 training)
   multiscale  bucketed {384,448,512} on a 576 canvas  (1 training)
+  multiscale+soft         same multiscale weights, soft-NMS (eval only)
+  stack2+multiscale       the two biggest levers composed  (1 training)
+  stack2+multiscale+soft  same composed weights, soft-NMS  (eval only)
 
 Rows merge into artifacts/r03/quality_matrix.json after every eval, so a
 tunnel wedge loses at most the in-flight run; rerunning skips completed
@@ -163,15 +166,17 @@ def main() -> None:
             cks, key=lambda d: int(d.rsplit("_", 1)[1])))
 
     def run_training(save, cfg):
-        """Train into `save` unless its DONE marker exists. Dir existence is
-        not evidence of completion — a wedged run leaves a partial
-        checkpoint that would silently skew every row scored from it
-        (review finding); only a training that RETURNED writes the marker.
-        A partial dir is cleared and retrained from scratch."""
+        """Train into `save` unless its DONE marker exists; returns the
+        training wall seconds (from the marker if already complete). Dir
+        existence is not evidence of completion — a wedged run leaves a
+        partial checkpoint that would silently skew every row scored from
+        it (review finding); only a training that RETURNED writes the
+        marker. A partial dir is cleared and retrained from scratch."""
         marker = os.path.join(save, "TRAIN_DONE")
         if os.path.exists(marker):
             log("training %s already complete (marker)" % save)
-            return
+            with open(marker) as f:
+                return float(f.read().strip().split("=")[1])
         if os.path.isdir(save) and os.listdir(save):
             log("partial training at %s; clearing and retraining" % save)
             import shutil
@@ -179,9 +184,11 @@ def main() -> None:
         os.makedirs(save, exist_ok=True)
         t0 = time.time()
         train(cfg)
+        wall = time.time() - t0
         with open(marker, "w") as f:
-            f.write("wall_s=%.1f\n" % (time.time() - t0))
-        log("training %s done in %.0fs" % (save, time.time() - t0))
+            f.write("wall_s=%.1f\n" % wall)
+        log("training %s done in %.0fs" % (save, wall))
+        return wall
 
     def record(row, mapping, t0, save, extra=None):
         # compute_map returns {"ap": {class_index: ap}, "map": float}
@@ -232,14 +239,50 @@ def main() -> None:
         record("stack2", m, t0, save)
 
     # ---- bucketed multiscale training -----------------------------------
+    ms_save = os.path.join(WORK_ROOT, "multiscale")
+    ms_kw = dict(multiscale_flag=True, prewarm=True,
+                 multiscale=([64, 128, 64] if smoke else [384, 576, 64]))
+    ms_train_wall = None
+    if want("multiscale") or want("multiscale+soft"):
+        ms_train_wall = run_training(ms_save, train_cfg(ms_save, **ms_kw))
     if want("multiscale"):
-        save = os.path.join(WORK_ROOT, "multiscale")
+        # wall_s on shared-training rows is EVAL-only; the training cost
+        # is recorded once as train_wall_s (review finding: silently
+        # changing wall_s's meaning vs prior rounds' train+eval rows)
         t0 = time.time()
-        run_training(save, train_cfg(
-            save, multiscale_flag=True, prewarm=True,
-            multiscale=([64, 128, 64] if smoke else [384, 576, 64])))
-        m = evaluate(eval_cfg(save, latest_ckpt(save)))
-        record("multiscale", m, t0, save)
+        m = evaluate(eval_cfg(ms_save, latest_ckpt(ms_save)))
+        record("multiscale", m, t0, ms_save,
+               extra={"train_wall_s": ms_train_wall})
+    if want("multiscale+soft"):
+        # the r4 CPU matrix's best two-lever composition (+5.8 at 256^2:
+        # multiscale 0.5611 -> +soft-NMS 0.5881, artifacts/r04/README.md)
+        # confirmed at flagship scale for free — eval-only on the same
+        # multiscale weights (VERDICT r4 next #9)
+        t0 = time.time()
+        m = evaluate(eval_cfg(ms_save, latest_ckpt(ms_save),
+                              nms="soft-nms"))
+        record("multiscale+soft", m, t0, ms_save)
+
+    # ---- best composed recipe: stack2 + multiscale (+ soft-NMS eval) ----
+    # stack2 is the biggest single lever (+21.3 at 256^2) and multiscale/
+    # soft-NMS compose on top of each other; whether they compose with
+    # stack2 has never been measured at any scale. One extra training
+    # yields both composed rows (soft-NMS is eval-only).
+    s2m_save = os.path.join(WORK_ROOT, "stack2_multiscale")
+    s2m_train_wall = None
+    if want("stack2+multiscale") or want("stack2+multiscale+soft"):
+        s2m_train_wall = run_training(
+            s2m_save, train_cfg(s2m_save, num_stack=2, **ms_kw))
+    if want("stack2+multiscale"):
+        t0 = time.time()
+        m = evaluate(eval_cfg(s2m_save, latest_ckpt(s2m_save), num_stack=2))
+        record("stack2+multiscale", m, t0, s2m_save,
+               extra={"train_wall_s": s2m_train_wall})
+    if want("stack2+multiscale+soft"):
+        t0 = time.time()
+        m = evaluate(eval_cfg(s2m_save, latest_ckpt(s2m_save), num_stack=2,
+                              nms="soft-nms"))
+        record("stack2+multiscale+soft", m, t0, s2m_save)
 
     flush()
     print(json.dumps(results))
