@@ -55,12 +55,20 @@ def flush():
 
 ckpt = os.path.join(save, "check_point_60")
 if not os.path.isdir(ckpt):
+    # salvage a crashed run from its newest ckpt_interval=5 checkpoint
+    # (multi-hour box hangs are documented; train() resumes from
+    # model_load at ckpt_epoch+1)
+    cks = [d for d in os.listdir(save) if d.startswith("check_point_")] \
+        if os.path.isdir(save) else []
+    resume = (os.path.join(save, max(
+        cks, key=lambda d: int(d.rsplit("_", 1)[1]))) if cks else "")
     # "fixed 256" is expressed exactly as the r3/r4 base rows did it:
     # single-bucket multiscale range(256, 320, 64) = {256} (the recipe
     # r4's ema_budget.py reproduced bit-for-bit against r3's base row)
     cfg = Config(train_flag=True, data=root, save_path=save, end_epoch=60,
                  lr=1e-3, lr_milestone=[30, 54], imsize=None,
                  multiscale_flag=True, multiscale=[256, 320, 64],
+                 model_load=resume,
                  ckpt_interval=5, keep_ckpt=2, print_interval=200, **base)
     t0 = time.time()
     train(cfg)

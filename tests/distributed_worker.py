@@ -63,6 +63,7 @@ def main() -> None:
     assert len(jax.local_devices()) == ndev_local
 
     mesh = make_mesh(world * ndev_local, spatial=spatial)
+
     model = build_model(cfg)
     tx = build_optimizer(cfg, 10)
     state = create_train_state(model, cfg, jax.random.key(0), IMSIZE, tx)
@@ -76,7 +77,22 @@ def main() -> None:
     local = tuple(a[rank * per:(rank + 1) * per] for a in g)
     arrays = shard_batch(mesh, local, spatial_dims=[1] * 5)
 
-    state, losses = step(state, *arrays)
+    # AOT-compile, BARRIER, then execute. Every compiled program creates
+    # its own fresh Gloo context at first execution (observed keys
+    # cpu:gloo/<devices>/1, /2, ...), and that context's KeyValue
+    # exchange carries a hard 30 s deadline — but per-rank compile times
+    # on a loaded 1-core box skew by minutes, so executing straight out
+    # of jit tripped the deadline (flaky DEADLINE_EXCEEDED, 2 of 4 full
+    # suite runs). The coordination-service barrier (gRPC — no Gloo, so
+    # no 30 s context deadline of its own) realigns the ranks after the
+    # skewed compiles; the first execution then starts within
+    # milliseconds on every rank.
+    compiled = step.lower(state, *arrays).compile()
+    if world > 1:  # single-rank smoke runs have no coordination client
+        from jax._src import distributed
+        distributed.global_state.client.wait_at_barrier(
+            "train_step_compiled", timeout_in_ms=15 * 60 * 1000)
+    state, losses = compiled(state, *arrays)
     jax.block_until_ready(losses["total"])
     result = {k: float(v) for k, v in losses.items()}
     result["param0"] = float(
