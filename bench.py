@@ -65,6 +65,20 @@ PEAK_BF16 = {
 }
 DEFAULT_PEAK = 1.97e14  # v5e — the BASELINE.json target chip
 
+# The artifacts/<round> directory every round-scoped script writes into.
+# ONE default, shared by quality_matrix.py, tpu_sweep.py, mfu_breakdown.py
+# and runner_drive.py (they diverged in r5: mfu_breakdown defaulted to r05
+# while the rest stayed at r04, scattering same-round artifacts — ADVICE
+# r5 #3); bump it here when a new round starts, or override per-run with
+# $GRAFT_ROUND. r06 = the process-loader round (ISSUE 1); earlier rounds'
+# artifact dirs are committed history and must not be overwritten.
+GRAFT_ROUND_DEFAULT = "r06"
+
+
+def graft_round() -> str:
+    """artifacts/<round> name: $GRAFT_ROUND or the shared default."""
+    return os.environ.get("GRAFT_ROUND", GRAFT_ROUND_DEFAULT)
+
 
 def log(msg: str) -> None:
     print("[bench] %s" % msg, file=sys.stderr, flush=True)
@@ -384,10 +398,15 @@ def main() -> None:
         tcompiled = jax.jit(train_n, donate_argnums=(0,)).lower(
             state, *arrs).compile()
         train_flops = flops_of(tcompiled)
-        # warmup run consumes (donates) `state`; rebuild for the timed run
+        # warmup run consumes (donates) `state`; rebuild for the timed run.
+        # The program returns (final state, last loss) so every donated
+        # buffer has an output to alias (donation actually elides the
+        # copy — no "donated buffers were not usable" warning); fetch ONLY
+        # the scalar loss so the full state never crosses D2H.
         np.asarray(tcompiled(state, *arrs)[1])
         state = create_train_state(tmodel, tcfg, jax.random.key(0), imsize, tx)
-        dt = timed_fetch(tcompiled, (state, *arrs), overhead, repeats=1)
+        dt = timed_fetch(lambda *a: tcompiled(*a)[1], (state, *arrs),
+                         overhead, repeats=1)
         out["train_img_per_sec_chip"] = round(train_batch * n_train / dt, 2)
         out["train_batch"] = train_batch
         out["train_step_ms"] = round(dt / n_train * 1e3, 3)

@@ -1,0 +1,210 @@
+"""Host input-pipeline throughput: thread vs process loader A/B.
+
+Successor to the r5 snapshot (artifacts/r05/calibration/host_loader_bench.py,
+which measured the thread loader only and put the "budget ~9 host cores per
+chip" number on the input-bound risk). This maintained version adds the
+`--ab` mode the process loader PR (ISSUE 1) is judged on:
+
+  default      thread loader, both wire formats (the r5 measurement,
+               reproduced against the current code)
+  --ab         full matrix: {thread, process} x --workers counts x
+               {host_encoded, host_raw} — ONE JSON, flushed after every
+               config so a killed run loses at most the in-flight cell
+
+  host_encoded  full host path: decode+augment+encode+normalize (f32 wire)
+  host_raw      --device-augment wire: decode+augment only (uint8 wire)
+
+The chip-consumption anchor (what the host must feed) comes from the
+newest committed on-chip bench via `bench.find_last_tpu_result()`; the
+r4 flagship number (435.1 img/s) is the fallback.
+
+Interpretation on a 1-core box (this container): the process loader can
+only show pool overhead, not parallel speedup — the acceptance bar is
+parity (within ~10% of the thread loader) plus an exercised >=2-worker
+path, so the multi-core win is measurable the moment a bigger host runs
+the same command. Writes artifacts/<round>/calibration/
+host_loader_bench.json (round from bench.graft_round()).
+
+Run: python calibration/host_loader_bench.py [--ab] [--images N]
+     [--imsize N] [--batch N] [--workers 1 2 4]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from bench import find_last_tpu_result, graft_round  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = "/tmp/loader_bench_voc"
+
+
+def log(msg: str) -> None:
+    print("[loader_bench] %s" % msg, file=sys.stderr, flush=True)
+
+
+def chip_anchor():
+    last = find_last_tpu_result(REPO)
+    if last and last.get("train_img_per_sec_chip"):
+        return float(last["train_img_per_sec_chip"]), last.get("path")
+    return 435.1, "artifacts/r04/BENCH_r04_local.json (fallback constant)"
+
+
+def time_one_epoch(loader) -> dict:
+    """Warm one epoch (page cache, pool/thread spin-up, spawn cost out of
+    the steady-state number), then time one."""
+    for _ in loader:
+        pass
+    t0 = time.time()
+    n = 0
+    batches = 0
+    for b in loader:
+        n += b.image.shape[0]
+        batches += 1
+    dt = time.time() - t0
+    return {"img_per_sec": round(n / dt, 2),
+            "sec_per_batch": round(dt / max(batches, 1), 3),
+            "images": n, "wall_s": round(dt, 2)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ab", action="store_true",
+                    help="A/B both loaders over --workers counts")
+    ap.add_argument("--images", type=int, default=96)
+    ap.add_argument("--imsize", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--workers", type=int, nargs="+", default=[1, 2, 4])
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    out_path = args.out or os.path.join(
+        REPO, "artifacts", graft_round(), "calibration",
+        "host_loader_bench.json")
+
+    from real_time_helmet_detection_tpu.data import make_synthetic_voc
+    from real_time_helmet_detection_tpu.data.augment import TrainAugmentor
+    from real_time_helmet_detection_tpu.data.pipeline import BatchLoader
+    from real_time_helmet_detection_tpu.data.shm_pool import \
+        ProcessBatchLoader
+    from real_time_helmet_detection_tpu.data.voc import VOCDataset
+
+    ds_meta = {"n": args.images, "imsize": args.imsize}
+    meta_path = os.path.join(DATA, "bench_meta.json")
+    have = None
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                have = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            have = None
+    if have != ds_meta:
+        log("generating %d x %d^2 scenes images..."
+            % (args.images, args.imsize))
+        import shutil
+        if os.path.isdir(DATA):
+            shutil.rmtree(DATA)
+        make_synthetic_voc(DATA, num_train=args.images, num_test=2,
+                           imsize=(args.imsize, args.imsize), max_objects=12,
+                           seed=3, style="scenes")
+        with open(meta_path, "w") as f:
+            json.dump(ds_meta, f)
+
+    dataset = VOCDataset(DATA, image_set="trainval")
+    chip, chip_src = chip_anchor()
+    results = {"imsize": args.imsize, "n_images": len(dataset),
+               "batch": args.batch, "host_cores": os.cpu_count(),
+               "chip_consumption_img_s": chip,
+               "chip_consumption_src": chip_src,
+               "modes": {}, "ab": {}}
+
+    def flush():
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=1)
+
+    def make_loader(kind, raw, workers):
+        aug = TrainAugmentor(multiscale_flag=False,
+                             multiscale=[args.imsize, args.imsize, 64],
+                             rng=np.random.default_rng(0))
+        cls = ProcessBatchLoader if kind == "process" else BatchLoader
+        return cls(dataset, aug, args.batch, num_workers=workers,
+                   prefetch=2, raw=raw)
+
+    wires = (("host_encoded", False), ("host_raw", True))
+
+    # -- thread-only quick section (the r5 measurement, kept comparable) --
+    for mode, raw in wires:
+        loader = make_loader("thread", raw, workers=4)
+        results["modes"][mode] = time_one_epoch(loader)
+        log("%s (thread, w4): %.1f img/s"
+            % (mode, results["modes"][mode]["img_per_sec"]))
+        flush()
+    enc = results["modes"]["host_encoded"]["img_per_sec"]
+    results["hosts_per_chip_at_flagship"] = round(chip / enc, 2)
+    flush()
+
+    if args.ab:
+        # Drift control: this box's effective speed swings ~2x over hours
+        # and ~20-30% within minutes (CLAUDE.md), so per (mode, workers)
+        # cell the two loaders are measured ALTERNATED (t, p, t, p) with
+        # warm pools and the best epoch wins — a loader-major loop would
+        # charge the drift to whichever loader ran later (the r6 first cut
+        # did exactly that and mismeasured the process loader at 0.5x)
+        for mode, raw in wires:
+            results["ab"][mode] = {"thread": {}, "process": {}}
+            for w in sorted(set(args.workers)):
+                loaders = {k: make_loader(k, raw, workers=w)
+                           for k in ("thread", "process")}
+                try:
+                    best = {}
+                    for _ in loaders["process"]:
+                        pass  # spin the pool up outside the timed epochs
+                    for _rep in range(2):
+                        for kind in ("thread", "process"):
+                            rec = time_one_epoch(loaders[kind])
+                            if kind not in best or rec["img_per_sec"] > \
+                                    best[kind]["img_per_sec"]:
+                                best[kind] = rec
+                finally:
+                    for ld in loaders.values():
+                        if hasattr(ld, "close"):
+                            ld.close()
+                for kind, rec in best.items():
+                    if getattr(loaders[kind], "_fell_back", False):
+                        rec["fell_back_to_thread"] = True
+                    results["ab"][mode][kind]["w%d" % w] = rec
+                    log("%s %s w%d: %.1f img/s (best of 2)"
+                        % (mode, kind, w, rec["img_per_sec"]))
+                flush()
+        # parity summary at each worker count (acceptance: process within
+        # 10% of thread on a 1-core box; speedup > 1 on real multi-core).
+        # The box's load swings make single cells noisy even best-of-2
+        # (adjacent same-loader cells have measured 3x apart), so the
+        # MEDIAN across cells is the stable acceptance number.
+        parity = {}
+        for mode, _ in wires:
+            for w in sorted(set(args.workers)):
+                key = "w%d" % w
+                th = results["ab"][mode]["thread"][key]["img_per_sec"]
+                pr = results["ab"][mode]["process"][key]["img_per_sec"]
+                parity["%s_%s" % (mode, key)] = round(pr / th, 3)
+        results["process_over_thread"] = parity
+        vals = sorted(parity.values())
+        mid = len(vals) // 2
+        results["parity_median"] = round(
+            vals[mid] if len(vals) % 2 else (vals[mid - 1] + vals[mid]) / 2,
+            3)
+        flush()
+
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

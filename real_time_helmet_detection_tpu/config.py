@@ -63,7 +63,19 @@ class Config:
     sub_divisions: int = 1        # gradient accumulation (ref train.py:124)
     start_epoch: int = 0
     end_epoch: int = 100
-    num_workers: int = 8          # host-side data pipeline threads
+    num_workers: int = 8          # host-side data pipeline workers
+    # (threads or processes, per --loader)
+    loader: str = "thread"        # host input-pipeline backend:
+    # "thread" = GIL-bound worker threads (zero setup cost; fine when the
+    # device step dominates); "process" = spawn-safe worker processes with
+    # SharedMemory batch transport (data/shm_pool.py) — GIL-free scaling
+    # over host cores for input-bound configs; bit-identical batches
+    # (tested), auto-fallback to the thread path if a worker dies
+    device_prefetch: int = 0      # stage the next N batches' sharded
+    # jax.device_put ahead of the train/eval step so H2D overlaps device
+    # compute (0 disables); each staged batch pins one batch of device
+    # memory. No reference analogue (DataLoader pin_memory + CUDA streams
+    # do this implicitly on GPU)
 
     # precision (TPU: bf16 policy replaces CUDA AMP + GradScaler)
     amp: bool = False
@@ -184,6 +196,12 @@ class Config:
     # --no-summary disables). Shape inference only — no device compute.
 
     def __post_init__(self):
+        if self.loader not in ("thread", "process"):
+            raise ValueError("--loader must be 'thread' or 'process', got %r"
+                             % self.loader)
+        if self.device_prefetch < 0:
+            raise ValueError("--device-prefetch must be >= 0, got %d"
+                             % self.device_prefetch)
         if self.scale_factor != 4:
             raise ValueError(
                 "--scale_factor must be 4: the stem's 4x downsample is "

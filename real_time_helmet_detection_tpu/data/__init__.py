@@ -2,15 +2,18 @@
 
 from .voc import CLASS2COLOR, CLASS2INDEX, INDEX2CLASS, VOCDataset
 from .augment import TestAugmentor, TrainAugmentor
-from .pipeline import (Batch, BatchLoader, DeviceDatasetCache, collate,
-                       epoch_indices, load_dataset)
+from .pipeline import (Batch, BatchLoader, DeviceDatasetCache,
+                       DevicePrefetcher, StagedBatch, collate, epoch_indices,
+                       load_dataset, seed_augmentor_for_batch)
+from .shm_pool import ProcessBatchLoader
 from .synthetic import make_synthetic_voc, synthetic_target_batch
 
 __all__ = [
     "CLASS2COLOR", "CLASS2INDEX", "INDEX2CLASS", "VOCDataset",
     "TestAugmentor", "TrainAugmentor",
-    "Batch", "BatchLoader", "DeviceDatasetCache", "collate",
-    "epoch_indices", "load_dataset",
+    "Batch", "BatchLoader", "DeviceDatasetCache", "DevicePrefetcher",
+    "ProcessBatchLoader", "StagedBatch", "collate",
+    "epoch_indices", "load_dataset", "seed_augmentor_for_batch",
     "make_synthetic_voc",
     "synthetic_target_batch",
 ]

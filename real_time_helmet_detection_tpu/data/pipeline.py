@@ -16,6 +16,18 @@ TPU-first: batches are channels-last numpy, padded GT box arrays
 (`max_boxes` static) ride along so the on-device `encode_boxes_jax` path can
 be used instead of host encoding; drop_last semantics keep the global batch
 shape static across steps (XLA recompile avoidance).
+
+Two producer backends share these semantics (batch content is a pure
+function of (seed, epoch, batch_index) on both — `seed_augmentor_for_batch`
+— so they are bit-identical and interchangeable mid-run):
+
+  * `BatchLoader` (here): worker THREADS — zero setup cost, GIL-bound for
+    the numpy stages (`--loader thread`, the default);
+  * `shm_pool.ProcessBatchLoader`: worker PROCESSES + shared-memory batch
+    transport — GIL-free scaling over host cores (`--loader process`).
+
+`DevicePrefetcher` (here) is the device-side half: it dispatches the next
+batch's sharded `jax.device_put` while the current step executes.
 """
 
 from __future__ import annotations
@@ -24,7 +36,7 @@ import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import Iterator, List, Optional, Sequence
+from typing import Any, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -50,6 +62,26 @@ class Batch:
 _overflow_warned = False
 
 
+def seed_augmentor_for_batch(augmentor, seed: int, epoch: int,
+                             batch_idx: int) -> None:
+    """Reseed a random augmentor's generator from (seed, epoch, batch_idx).
+
+    This makes every batch's content a pure function of its coordinates —
+    the property both loader backends (thread `BatchLoader`, process
+    `shm_pool.ProcessBatchLoader`) rely on to be **bit-identical** to each
+    other at a fixed (seed, epoch), and what lets the process loader's
+    crash fallback continue an epoch with identical bytes. It also makes
+    epochs independent of iteration history (a resumed run sees the same
+    augmentation stream as an uninterrupted one — stronger than the
+    reference's `sampler.set_epoch`, which reshuffles order but lets the
+    imgaug RNG drift, ref train.py:67). Deterministic augmentors (no `rng`
+    attribute, e.g. `TestAugmentor`) are left untouched.
+    """
+    if hasattr(augmentor, "rng"):
+        augmentor.rng = np.random.default_rng(
+            np.random.SeedSequence((seed, epoch, batch_idx)))
+
+
 def pad_boxes(boxes: np.ndarray, labels: np.ndarray, max_boxes: int):
     global _overflow_warned
     n = min(len(boxes), max_boxes)
@@ -67,39 +99,76 @@ def pad_boxes(boxes: np.ndarray, labels: np.ndarray, max_boxes: int):
     return b, l, v
 
 
+def _stack_into(alloc, name: str, arrays) -> np.ndarray:
+    """np.stack, optionally into `alloc`-provided storage (zero extra copy
+    beyond the per-element writes np.stack performs anyway)."""
+    if alloc is None:
+        return np.stack(arrays)
+    out = alloc(name, (len(arrays),) + tuple(arrays[0].shape),
+                arrays[0].dtype)
+    for i, a in enumerate(arrays):
+        out[i] = a
+    return out
+
+
 def collate(samples: Sequence, augmentor, pretrained: str = "imagenet",
             num_cls: int = 2, normalized_coord: bool = False,
             scale_factor: int = 4, max_boxes: int = 128,
-            raw: bool = False) -> Batch:
+            raw: bool = False, alloc=None) -> Batch:
     """samples: list of (img, boxes, labels, voc_dict) from `VOCDataset`.
 
     `raw=True` is the device-augment input mode: images stay un-normalized
     uint8 canvases and no target maps are encoded — augmentation, GT
     encoding, float cast and normalization all happen on the accelerator
     inside the train step (data/augment_device.py).
+
+    `alloc(name, shape, dtype) -> writable ZERO-INITIALIZED array`:
+    optional allocator for the bulk output arrays. The process loader's
+    workers (data/shm_pool.py) pass one that carves the arrays straight
+    out of a per-batch shared-memory segment, so the batch is built IN the
+    cross-process transport with no extra copy on either side (fresh
+    segment pages are kernel-zeroed, satisfying the zero-init contract the
+    native encoder's accumulation needs). Default: plain numpy arrays —
+    byte-identical output either way.
     """
     imgs, boxes, labels, infos = zip(*samples)
     imgs, boxes, labels = augmentor(list(imgs), list(boxes), list(labels))
 
     size = imgs[0].shape[0]  # square; shared across the batch
-    pb, pl, pv = zip(*(pad_boxes(b, l, max_boxes)
-                       for b, l in zip(boxes, labels)))
-    pb, pl, pv = np.stack(pb), np.stack(pl), np.stack(pv)
+    pb_, pl_, pv_ = zip(*(pad_boxes(b, l, max_boxes)
+                          for b, l in zip(boxes, labels)))
+    pb = _stack_into(alloc, "boxes", pb_)
+    pl = _stack_into(alloc, "labels", pl_)
+    pv = _stack_into(alloc, "valid", pv_)
 
     if raw:
         # uint8 on the wire: the augmentors return uint8 canvases and the
         # fused device step casts to float32 on-chip — shipping float32
         # would quadruple host->device traffic for identical bits
-        empty = np.zeros((len(imgs), 0, 0, 0), np.float32)
-        return Batch(image=np.stack(imgs), heatmap=empty,
-                     offset=empty, wh=empty, mask=empty, boxes=pb, labels=pl,
+        image = _stack_into(alloc, "image", imgs)
+        if alloc is None:
+            empty = np.zeros((len(imgs), 0, 0, 0), np.float32)
+            empties = (empty,) * 4
+        else:
+            empties = tuple(alloc(n, (len(imgs), 0, 0, 0), np.float32)
+                            for n in ("heatmap", "offset", "wh", "mask"))
+        return Batch(image=image, heatmap=empties[0], offset=empties[1],
+                     wh=empties[2], mask=empties[3], boxes=pb, labels=pl,
                      valid=pv, infos=list(infos))
 
     # native C++ encoder (one call for the whole batch) when built;
     # identical-semantics numpy fallback otherwise
     counts = pv.sum(axis=1).astype(np.int32)
+    maps_out = None
+    if alloc is not None:
+        b, m = len(imgs), size // scale_factor
+        maps_out = (alloc("heatmap", (b, m, m, num_cls), np.float32),
+                    alloc("offset", (b, m, m, 2), np.float32),
+                    alloc("wh", (b, m, m, 2), np.float32),
+                    alloc("mask", (b, m, m, 1), np.float32))
     out = encode_boxes_batch_native(pb, pl, counts, (size, size),
-                                    scale_factor, num_cls, normalized_coord)
+                                    scale_factor, num_cls, normalized_coord,
+                                    out=maps_out)
     if out is not None:
         heat, off, wh, mask = out
     else:
@@ -109,9 +178,19 @@ def collate(samples: Sequence, augmentor, pretrained: str = "imagenet",
                             (size, size), scale_factor, num_cls,
                             normalized_coord)
                for i in range(len(pb))]
-        heat, off, wh, mask = (np.stack(x) for x in zip(*per))
+        if maps_out is None:
+            heat, off, wh, mask = (np.stack(x) for x in zip(*per))
+        else:
+            heat, off, wh, mask = maps_out
+            for i, (h, o, w, mk) in enumerate(per):
+                heat[i], off[i], wh[i], mask[i] = h, o, w, mk
 
-    image = np.stack([normalize_image(im, pretrained) for im in imgs])
+    if alloc is None:
+        image = np.stack([normalize_image(im, pretrained) for im in imgs])
+    else:
+        image = alloc("image", (len(imgs), size, size, 3), np.float32)
+        for i, im in enumerate(imgs):
+            image[i] = normalize_image(im, pretrained)
     return Batch(image=image, heatmap=heat, offset=off, wh=wh, mask=mask,
                  boxes=pb, labels=pl, valid=pv, infos=list(infos))
 
@@ -144,13 +223,16 @@ class BatchLoader:
 
     Scaling note (measured r5, artifacts/r05/calibration/
     host_loader_bench.json): this thread-based loader is GIL-bound for
-    the numpy stages and delivers ~49 img/s per host core at 512^2 on
-    the full path (decode+augment+encode+normalize) and ~91 img/s on the
-    raw uint8 wire (`raw=True`, the --device-augment input mode) — vs a
-    chip consuming 435 img/s at the flagship train config. On a real
-    pod, budget ~9 host cores per chip for the full host path, ~5 with
-    --device-augment, or use --cache-device (decode once, gather batches
-    on-device) to take the host off the steady-state path entirely.
+    the numpy stages — ~49 img/s per host core at 512^2 on the full path
+    (decode+augment+encode+normalize), ~91 img/s on the raw uint8 wire
+    (`raw=True`, the --device-augment input mode) — vs a chip consuming
+    435 img/s at the flagship train config. When the host is the
+    bottleneck, select `--loader process` (`shm_pool.ProcessBatchLoader`:
+    GIL-free worker processes + shared-memory batch transport,
+    bit-identical batches) and size `--num-workers` to the host's cores;
+    see docs/ARCHITECTURE.md's loader decision table. Batch content is a
+    pure function of (seed, epoch, batch_index) on both backends
+    (`seed_augmentor_for_batch`).
     """
 
     def __init__(self, dataset, augmentor, batch_size: int,
@@ -187,11 +269,18 @@ class BatchLoader:
         n = len(self._indices())
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
-    def _make_batch(self, pool: ThreadPoolExecutor, idx_chunk) -> Batch:
+    def _make_batch(self, pool: ThreadPoolExecutor, idx_chunk,
+                    epoch: Optional[int] = None,
+                    batch_idx: Optional[int] = None) -> Batch:
         samples = list(pool.map(self.dataset.__getitem__, idx_chunk))
+        if batch_idx is not None:
+            seed_augmentor_for_batch(self.augmentor, self.seed,
+                                     self.epoch if epoch is None else epoch,
+                                     batch_idx)
         return collate(samples, self.augmentor, **self.kw)
 
     def __iter__(self) -> Iterator[Batch]:
+        epoch = self.epoch
         idx = self._indices()
         nb = len(self)
         chunks = [idx[i * self.batch_size:(i + 1) * self.batch_size]
@@ -213,10 +302,11 @@ class BatchLoader:
         def producer():
             try:
                 with ThreadPoolExecutor(self.num_workers) as pool:
-                    for chunk in chunks:
+                    for bi, chunk in enumerate(chunks):
                         if stop.is_set():
                             return
-                        if not put(self._make_batch(pool, chunk)):
+                        if not put(self._make_batch(pool, chunk, epoch=epoch,
+                                                    batch_idx=bi)):
                             return
                 put(None)
             except BaseException as e:  # surface decode/augment failures
@@ -234,6 +324,49 @@ class BatchLoader:
                 yield item
         finally:
             stop.set()
+
+
+@dataclass
+class StagedBatch:
+    """A host `Batch` whose device transfer has already been dispatched.
+
+    `arrays` is the sharded device pytree a train/eval step consumes;
+    `host` keeps the originating `Batch` for host-side consumers (eval
+    infos, training-log snapshots). Produced by `DevicePrefetcher`."""
+    arrays: Any
+    host: Any
+
+
+class DevicePrefetcher:
+    """Overlap H2D transfer with device compute: stage each item's
+    `stage(item)` (typically a sharded `jax.device_put` / `shard_batch`)
+    up to `depth` items ahead of the consumer.
+
+    JAX dispatch is asynchronous, so `stage` returns as soon as the
+    transfer is enqueued; holding `depth` staged batches in a deque means
+    batch i+1's host->device copy streams while the step for batch i
+    executes — the double-buffering the reference gets implicitly from
+    `DataLoader(pin_memory=True)` + CUDA streams, made explicit for the
+    TPU (where the serial H2D of a 3 MB uint8 batch over a slow transport
+    can rival the 37 ms step itself). Each staged item pins its device
+    buffers until consumed, so `depth` bounds the extra device memory at
+    `depth * batch_bytes`.
+    """
+
+    def __init__(self, iterable, stage, depth: int = 1):
+        self.iterable = iterable
+        self.stage = stage
+        self.depth = max(1, int(depth))
+
+    def __iter__(self) -> Iterator[StagedBatch]:
+        from collections import deque
+        buf: deque = deque()
+        for item in self.iterable:
+            buf.append(StagedBatch(self.stage(item), item))
+            if len(buf) > self.depth:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
 
 
 class DeviceDatasetCache:

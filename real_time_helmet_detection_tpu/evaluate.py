@@ -29,8 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import Config
-from .data import (CLASS2COLOR, INDEX2CLASS, BatchLoader, TestAugmentor,
-                   VOCDataset, load_dataset)
+from .data import (CLASS2COLOR, INDEX2CLASS, BatchLoader, DevicePrefetcher,
+                   StagedBatch, TestAugmentor, VOCDataset, load_dataset)
 from .models import build_model
 from .predict import make_predict_fn
 from .train import init_variables, restore_variables
@@ -108,13 +108,19 @@ def evaluate(cfg: Config) -> Dict:
                               mesh=mesh)
 
     dataset, augmentor = load_dataset(cfg)
-    loader = BatchLoader(dataset, augmentor, batch_size=cfg.batch_size,
-                         pretrained=cfg.pretrained, num_cls=cfg.num_cls,
-                         normalized_coord=cfg.normalized_coord,
-                         scale_factor=cfg.scale_factor,
-                         max_boxes=cfg.max_boxes, shuffle=False,
-                         drop_last=False, num_workers=cfg.num_workers,
-                         rank=rank, world_size=world, raw=True)
+    loader_cls = BatchLoader
+    if cfg.loader == "process":
+        # same GIL-free pipeline as training (data/shm_pool.py); eval's
+        # deterministic augmentor makes the backends trivially identical
+        from .data import ProcessBatchLoader
+        loader_cls = ProcessBatchLoader
+    loader = loader_cls(dataset, augmentor, batch_size=cfg.batch_size,
+                        pretrained=cfg.pretrained, num_cls=cfg.num_cls,
+                        normalized_coord=cfg.normalized_coord,
+                        scale_factor=cfg.scale_factor,
+                        max_boxes=cfg.max_boxes, shuffle=False,
+                        drop_last=False, num_workers=cfg.num_workers,
+                        rank=rank, world_size=world, raw=True)
 
     txt_dir = os.path.join(cfg.save_path, "results", "txt")
     results: Dict[str, Dict] = {}
@@ -158,6 +164,37 @@ def evaluate(cfg: Config) -> Dict:
                 gb, gl = boxes_from_voc_dict(info)
                 gt_boxes[image_id], gt_labels[image_id] = gb, gl
 
+    def host_batches():
+        """(padded images, infos) stream off the loader."""
+        for batch in loader:
+            images = batch.image
+            if images.shape[0] < cfg.batch_size:
+                # pad the final partial batch to the steady-state shape:
+                # one jitted program for the whole eval instead of a second
+                # XLA compile on the odd last shape; `infos` bounds the
+                # consumption loop so padding rows are never read
+                pad = cfg.batch_size - images.shape[0]
+                images = np.concatenate(
+                    [images,
+                     np.zeros((pad,) + images.shape[1:], images.dtype)])
+            yield images, batch.infos
+
+    iterator = host_batches()
+    if cfg.device_prefetch > 0:
+        # --device-prefetch: dispatch the sharded H2D of the next N batches
+        # while the device predicts the current one (on top of the
+        # software-pipelined consume below)
+        from .parallel import batch_sharding
+        sharding = batch_sharding(mesh, 4) if mesh is not None else None
+
+        def stage(item):
+            images, _ = item
+            return (jax.device_put(images, sharding)
+                    if sharding is not None else jax.device_put(images))
+
+        iterator = DevicePrefetcher(iterator, stage,
+                                    depth=cfg.device_prefetch)
+
     # Software-pipelined loop (same shape as the async train loop): batch
     # i's device arrays are left un-fetched while batch i+1 is loaded and
     # dispatched, so host work (JPEG decode, box rescale, txt writes) and
@@ -165,21 +202,17 @@ def evaluate(cfg: Config) -> Dict:
     # waits. The reference eval is strictly sequential (evaluate.py:66-97).
     pending = None  # (un-fetched device dets, infos of that batch)
     tic = time.time()
-    for i, batch in enumerate(loader):
+    for i, item in enumerate(iterator):
         meters["data"].update(time.time() - tic)
         t0 = time.time()
-        images = batch.image
-        if images.shape[0] < cfg.batch_size:
-            # pad the final partial batch to the steady-state shape: one
-            # jitted program for the whole eval instead of a second XLA
-            # compile on the odd last shape; batch.infos bounds the
-            # consumption loop so padding rows are never read
-            pad = cfg.batch_size - images.shape[0]
-            images = np.concatenate(
-                [images, np.zeros((pad,) + images.shape[1:], images.dtype)])
-        # numpy goes straight to the jitted fn: pjit performs the (sharded,
-        # in the meshed case) H2D itself — an explicit jnp.asarray would
-        # commit the whole batch to device 0 first and re-distribute
+        if isinstance(item, StagedBatch):
+            images, infos = item.arrays, item.host[1]
+        else:
+            # numpy goes straight to the jitted fn: pjit performs the
+            # (sharded, in the meshed case) H2D itself — an explicit
+            # jnp.asarray would commit the whole batch to device 0 first
+            # and re-distribute
+            images, infos = item
         dets_dev = predict(variables, images)  # async dispatch
         meters["dispatch"].update(time.time() - t0)
         if pending is not None:
@@ -188,7 +221,7 @@ def evaluate(cfg: Config) -> Dict:
             # includes the device_get wait, i.e. any device time not hidden
             # behind the host work
             meters["consume"].update(time.time() - t0)
-        pending = (dets_dev, batch.infos)
+        pending = (dets_dev, infos)
 
         if i % max(1, cfg.print_interval // 10) == 0:
             print("%s: eval iter %d/%d, data %.3fs dispatch %.3fs "
@@ -201,6 +234,8 @@ def evaluate(cfg: Config) -> Dict:
         t0 = time.time()
         consume(jax.device_get(pending[0]), pending[1])
         meters["consume"].update(time.time() - t0)
+    if hasattr(loader, "close"):
+        loader.close()  # reap workers, unlink shared-memory slots
 
     if world > 1:
         m = _score_multihost(cfg, dataset, results, txt_dir, rank, world)

@@ -109,22 +109,32 @@ def encode_boxes_native(boxes, labels, imsize, scale_factor: int = 4,
 def encode_boxes_batch_native(boxes: np.ndarray, labels: np.ndarray,
                               counts: np.ndarray, imsize,
                               scale_factor: int = 4, num_cls: int = 2,
-                              normalized: bool = False
+                              normalized: bool = False,
+                              out: Optional[Tuple[np.ndarray, ...]] = None
                               ) -> Optional[Tuple[np.ndarray, ...]]:
     """Whole-batch encode in ONE native call (amortizes ctypes overhead
     across the collate). boxes (B, max_boxes, 4) padded, labels
     (B, max_boxes), counts (B,) valid-box counts. Returns None if the
-    native lib is unavailable."""
+    native lib is unavailable.
+
+    `out`: optional (heat, offset, size, mask) destination arrays —
+    C-contiguous float32 and ZERO-initialized (the C kernels accumulate
+    into them). The shm_pool workers pass views into a fresh shared-memory
+    segment (kernel-zeroed pages) so the encoded maps are built in place
+    with no extra copy."""
     lib = _load()
     if lib is None:
         return None
     batch, max_boxes = labels.shape
     width = int(imsize[0]) // scale_factor
     height = int(imsize[1]) // scale_factor
-    heat = np.zeros((batch, height, width, num_cls), np.float32)
-    offset = np.zeros((batch, height, width, 2), np.float32)
-    size = np.zeros((batch, height, width, 2), np.float32)
-    mask = np.zeros((batch, height, width, 1), np.float32)
+    if out is not None:
+        heat, offset, size, mask = out
+    else:
+        heat = np.zeros((batch, height, width, num_cls), np.float32)
+        offset = np.zeros((batch, height, width, 2), np.float32)
+        size = np.zeros((batch, height, width, 2), np.float32)
+        mask = np.zeros((batch, height, width, 1), np.float32)
     lib.encode_boxes_batch_f32(
         np.ascontiguousarray(boxes, dtype=np.float32),
         np.ascontiguousarray(labels, dtype=np.int32),

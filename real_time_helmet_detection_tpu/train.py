@@ -161,20 +161,28 @@ def make_train_step_body(model, tx, cfg: Config):
 
 def make_scanned_train_fn(body, n: int):
     """`n` sequential train steps inside ONE XLA program (`lax.scan` over a
-    `make_train_step_body` step), returning only scalars (final step
-    counter, last total loss).
+    `make_train_step_body` step), returning (final TrainState, last total
+    loss).
 
     The single timing harness both bench.py and scaling.py jit: dispatching
     one program keeps per-call overhead out of the measurement — on the
     remote-TPU tunnel each materializing dispatch costs ~70 ms and
     `block_until_ready` resolves before remote execution completes, so a
-    naive per-step loop measures nothing real."""
+    naive per-step loop measures nothing real.
+
+    The FULL final state is returned (not just its step counter) so that
+    jitting with `donate_argnums=(0,)` actually works: every donated input
+    buffer has a same-aval/same-sharding output to alias, the copy is
+    elided, and XLA emits no "Some donated buffers were not usable"
+    warning. Callers must time by fetching ONLY the scalar loss
+    (`compiled(...)[1]`) — fetching the state would drag the whole model
+    through the (slow) D2H transport and into the measurement."""
     def train_n(state, images, heat, off, wh, mask):
         def sbody(st, _):
             st, losses = body(st, images, heat, off, wh, mask)
             return st, losses["total"]
         st, totals = jax.lax.scan(sbody, state, None, length=n)
-        return st.step, totals[-1]
+        return st, totals[-1]
     return train_n
 
 
@@ -561,16 +569,30 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
     augment+encode+train step, one jit cache entry per multiscale bucket.
     Cached path (`--cache-device`): `batch` is a host index vector; the
     fused step gathers the batch from the HBM-resident `cache`.
+
+    The streaming runners expose `runner.stage(batch) -> device arrays`
+    (the sharded H2D transfer alone) and accept a `data.StagedBatch` in
+    place of the host batch — the `--device-prefetch` hook: train_epoch
+    wraps the loader in a `DevicePrefetcher` that calls `stage` up to N
+    batches ahead, so the H2D copy overlaps the previous step's compute.
+    The cached path has no stage (its per-step wire is a B-int32 vector).
     """
+    from .data import StagedBatch
+
     if not cfg.device_augment:
         step = make_train_step(model, tx, cfg, mesh)
 
-        def runner(state, batch, step_idx):
-            arrays = shard_batch(
+        def stage(batch):
+            return shard_batch(
                 mesh, (batch.image, batch.heatmap, batch.offset, batch.wh,
                        batch.mask), spatial_dims=[1] * 5)
+
+        def runner(state, batch, step_idx):
+            arrays = (batch.arrays if isinstance(batch, StagedBatch)
+                      else stage(batch))
             return step(state, *arrays)
 
+        runner.stage = stage
         return runner
 
     sizes = (list(range(cfg.multiscale[0], cfg.multiscale[1],
@@ -641,9 +663,14 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
                                                    target)
         return steps[target]
 
-    def runner(state, batch, step_idx):
-        images, boxes, labels, valid = shard_batch(
+    def stage(batch):
+        return shard_batch(
             mesh, (batch.image, batch.boxes, batch.labels, batch.valid))
+
+    def runner(state, batch, step_idx):
+        arrays = (batch.arrays if isinstance(batch, StagedBatch)
+                  else stage(batch))
+        images, boxes, labels, valid = arrays
         return get_step(pick_target(step_idx))(
             state, base_key, np.int32(step_idx), images, boxes, labels,
             valid)
@@ -661,6 +688,7 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
 
     runner.prewarm = lambda state: prewarm(state, _dummy_call)
     runner.steps = steps  # bucket -> jitted step (tests assert coverage)
+    runner.stage = stage
     return runner
 
 
@@ -685,9 +713,18 @@ class HangWatchdog:
         self._warned = False
         self._paused = False
         self._thread = None
+        self._status_fn = None
         if self.warn_seconds > 0:
             self._thread = threading.Thread(target=self._run, daemon=True)
             self._thread.start()
+
+    def set_status_fn(self, fn) -> None:
+        """Attach a () -> str status provider whose output is appended to
+        every warning — e.g. the process loader's per-worker heartbeat
+        ages (`ProcessBatchLoader.worker_status`), so a stall can be
+        attributed to the input pipeline vs the device transport at a
+        glance."""
+        self._status_fn = fn
 
     def beat(self, label: str) -> None:
         self._beat = time.monotonic()
@@ -713,10 +750,17 @@ class HangWatchdog:
             if stalled > self.warn_seconds and not self._warned \
                     and not self._paused:
                 self._warned = True
+                extra = ""
+                if self._status_fn is not None:
+                    try:
+                        extra = " | " + str(self._status_fn())
+                    except Exception:  # noqa: BLE001 — status is best-effort
+                        pass
                 print("%s: WATCHDOG: no %s progress for %.0fs (last: %s) — "
                       "the device transport may be wedged; if this "
-                      "persists, kill and resume from the last checkpoint"
-                      % (timestamp(), self.where, stalled, self._label),
+                      "persists, kill and resume from the last checkpoint%s"
+                      % (timestamp(), self.where, stalled, self._label,
+                         extra),
                       flush=True)
                 try:  # where is the main thread stuck? (needs a real fd —
                     faulthandler.dump_traceback(file=sys.__stderr__)
@@ -810,8 +854,17 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
             loss_log.append(fetched)
         pending.clear()
 
+    iterator = loader
+    if cfg.device_prefetch > 0 and hasattr(step_runner, "stage"):
+        # H2D overlap: the prefetcher dispatches the sharded device_put of
+        # the next `device_prefetch` batches while the current step runs.
+        # The cached input path has no stage (its wire is B int32 indices).
+        from .data import DevicePrefetcher
+        iterator = DevicePrefetcher(loader, step_runner.stage,
+                                    depth=cfg.device_prefetch)
+    from .data import StagedBatch
     tic = time.time()
-    for i, batch in enumerate(loader):
+    for i, batch in enumerate(iterator):
         if injector is not None:
             injector.maybe_fire(epoch, i)
         data_t = time.time() - tic
@@ -849,8 +902,9 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
             snapshot_dir = os.path.join(cfg.save_path, "training_log")
             # host-augment path only: raw batches carry no GT maps and
             # un-normalized images
+            host = batch.host if isinstance(batch, StagedBatch) else batch
             if os.path.isdir(snapshot_dir) and not cfg.device_augment:
-                blend_heatmap(batch.image, batch.heatmap, cfg.pretrained).save(
+                blend_heatmap(host.image, host.heatmap, cfg.pretrained).save(
                     os.path.join(snapshot_dir, f"e{epoch}_i{i}_gt.png"))
                 # single-host only: with multiple processes the snapshot
                 # output spans non-addressable devices (device_get would
@@ -858,8 +912,8 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
                 if snapshot_fn is not None and jax.process_count() == 1:
                     pred = jax.device_get(snapshot_fn(
                         state.params, state.batch_stats,
-                        jnp.asarray(batch.image)))
-                    blend_heatmap(batch.image, pred, cfg.pretrained).save(
+                        jnp.asarray(host.image)))
+                    blend_heatmap(host.image, pred, cfg.pretrained).save(
                         os.path.join(snapshot_dir, f"e{epoch}_i{i}_pred.png"))
         tic = time.time()
     flush_losses()
@@ -916,7 +970,15 @@ def train(cfg: Config) -> TrainState:
             seed=cfg.random_seed, num_workers=cfg.num_workers, mesh=mesh)
         loader = cache
     else:
-        loader = BatchLoader(
+        loader_cls = BatchLoader
+        if cfg.loader == "process":
+            # GIL-free host pipeline: spawned worker processes + shared-
+            # memory batch transport (data/shm_pool.py); bit-identical to
+            # the thread loader, with an automatic in-process fallback if
+            # a worker dies
+            from .data import ProcessBatchLoader
+            loader_cls = ProcessBatchLoader
+        loader = loader_cls(
             dataset, augmentor,
             batch_size=cfg.batch_size // jax.process_count(),
             pretrained=cfg.pretrained, num_cls=cfg.num_cls,
@@ -989,6 +1051,10 @@ def train(cfg: Config) -> TrainState:
         raise ValueError("--auto-resume requires synchronous checkpoints "
                          "(drop --async-ckpt)")
     watchdog = HangWatchdog(cfg.hang_warn_seconds)
+    if hasattr(loader, "worker_status"):
+        # the watchdog's stall warning names each loader worker's liveness
+        # and heartbeat age, so an input-pipeline stall is attributable
+        watchdog.set_status_fn(loader.worker_status)
     writer = CheckpointWriter(async_save=cfg.async_ckpt)
     injector = FaultInjector(cfg.fault_inject)
     epoch_flush = make_state_accum_flush(cfg, steps_per_epoch)
@@ -1151,4 +1217,6 @@ def train(cfg: Config) -> TrainState:
         watchdog.pause("finalizing checkpoints")
         writer.finalize()
         watchdog.stop()
+        if hasattr(loader, "close"):
+            loader.close()  # reap workers, unlink shared-memory slots
     return state

@@ -90,7 +90,11 @@ def child(n: int, per_chip_batch: int, imsize: int, iters: int,
 
     np.asarray(step(state, *arrs)[1])  # compile + warm (donates `state`)
     state = create_train_state(model, cfg, jax.random.key(0), imsize, tx)
-    dt = timed_fetch(step, (state, *arrs), overhead, repeats=1)
+    # fetch ONLY the scalar loss: the program also returns the final state
+    # (so donation has an output to alias), which must never enter the
+    # timed D2H
+    dt = timed_fetch(lambda *a: step(*a)[1], (state, *arrs), overhead,
+                     repeats=1)
     platform = jax.devices()[0].platform
     print(json.dumps({
         "devices": n, "platform": platform,

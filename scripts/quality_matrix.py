@@ -42,9 +42,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from bench import graft_round  # noqa: E402 — one shared round default
+
 OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "artifacts",
-    os.environ.get("GRAFT_ROUND", "r04"), "quality_matrix.json")
+    graft_round(), "quality_matrix.json")
 DATA_ROOT = "/tmp/voc_scenes_512"
 WORK_ROOT = "/tmp/qmatrix"
 
@@ -174,9 +176,19 @@ def main() -> None:
         marker. A partial dir is cleared and retrained from scratch."""
         marker = os.path.join(save, "TRAIN_DONE")
         if os.path.exists(marker):
-            log("training %s already complete (marker)" % save)
-            with open(marker) as f:
-                return float(f.read().strip().split("=")[1])
+            try:
+                with open(marker) as f:
+                    wall = float(f.read().strip().split("=")[1])
+            except (ValueError, IndexError, OSError) as e:
+                # empty/truncated marker (crash between create and write):
+                # NOT evidence of completion — fall through to the
+                # clear-and-retrain path below (ADVICE r5 #1; previously
+                # this raised and killed the whole matrix stage)
+                log("unparseable TRAIN_DONE marker at %s (%r); treating as "
+                    "a partial run" % (marker, e))
+            else:
+                log("training %s already complete (marker)" % save)
+                return wall
         if os.path.isdir(save) and os.listdir(save):
             log("partial training at %s; clearing and retraining" % save)
             import shutil

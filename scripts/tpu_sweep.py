@@ -13,7 +13,8 @@ independently guarded: a failed compile (e.g. OOM at large batch) records
 the error string instead of killing the sweep.
 
 The dev tunnel can wedge mid-run (CLAUDE.md), so results MERGE into
-artifacts/<round>/sweep.json (round from $GRAFT_ROUND, default r04) after
+artifacts/<round>/sweep.json (round from $GRAFT_ROUND, default
+bench.GRAFT_ROUND_DEFAULT — one constant for every round-scoped script) after
 every single config — a killed run loses at most the in-flight config —
 and `--only <section>[,<section>]` reruns just the missing sections
 (inference, train, stack2, remat, stack4_768).
@@ -30,8 +31,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import (DEFAULT_PEAK, PEAK_BF16, acquire_backend, flops_of, log,
-                   measure_dispatch_overhead, timed_fetch)
+from bench import (DEFAULT_PEAK, PEAK_BF16, acquire_backend, flops_of,
+                   graft_round, log, measure_dispatch_overhead, timed_fetch)
 
 
 def memory_analysis_of(compiled):
@@ -55,7 +56,7 @@ def memory_analysis_of(compiled):
 
 OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "artifacts",
-    os.environ.get("GRAFT_ROUND", "r04"), "sweep.json")
+    graft_round(), "sweep.json")
 
 # section name (CLI --only vocabulary) -> results key
 SECTION_KEYS = {"inference": "inference_batch_sweep",
@@ -241,7 +242,10 @@ def main() -> None:
         mem = memory_analysis_of(compiled)
         np.asarray(compiled(state, *arrs)[1])  # warmup (donates state)
         state = create_train_state(model, cfg, jax.random.key(0), sz, tx)
-        dt = timed_fetch(compiled, (state, *arrs), overhead, repeats=1)
+        # fetch only the scalar loss — the returned final state exists to
+        # give the donated input an aliasing target, not to be fetched
+        dt = timed_fetch(lambda *a: compiled(*a)[1], (state, *arrs),
+                         overhead, repeats=1)
         rec = {"batch": batch, "remat": remat, "imsize": sz,
                "num_stack": num_stack,
                "img_per_sec_chip": round(batch * n / dt, 1),

@@ -24,6 +24,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# see distributed_worker.py: this jax needs the CPU collectives named
+# explicitly or multi-process compiles fail outright
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except (AttributeError, ValueError) as _e:
+    print("warning: could not select gloo CPU collectives under jax %s "
+          "(%s); multi-process CPU compiles will likely fail"
+          % (jax.__version__, _e), flush=True)
 # compile cache via inherited JAX_COMPILATION_CACHE_DIR (conftest.py)
 
 from real_time_helmet_detection_tpu.config import Config  # noqa: E402

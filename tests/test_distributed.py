@@ -85,6 +85,22 @@ def _single_process_reference(global_batch: int):
             float(np.asarray(jax.tree.leaves(state.params)[0]).ravel()[0]))
 
 
+def test_two_process_rendezvous_smoke(tmp_path):
+    """Smoke-tier canary for the multi-process rendezvous + compile/execute
+    barrier path (ADVICE r5 #5: with every multi-process test slow-only,
+    a barrier regression would only surface in the 38-70 min full suite).
+    Cheapest real 2-process run — 1 device per rank, no single-process
+    reference model (that second compile is what makes the full variants
+    slow); replicated-result equality across ranks proves the rendezvous,
+    the barrier and the cross-process all-reduce all executed."""
+    results = _run_world(tmp_path, world=2, ndev_local=1)
+    assert results[0]["total"] == pytest.approx(results[1]["total"],
+                                                rel=1e-6)
+    assert results[0]["param0"] == pytest.approx(results[1]["param0"],
+                                                 rel=1e-6)
+    assert np.isfinite(results[0]["total"])
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("ndev_local", [1, 2])
 def test_two_process_train_step_matches_single(tmp_path, ndev_local):

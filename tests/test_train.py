@@ -398,11 +398,40 @@ def test_scanned_train_fn_matches_sequential_steps():
         seq_losses.append(float(losses["total"]))
 
     scanned = jax.jit(make_scanned_train_fn(body, 3))
-    n_steps, last_total = scanned(state, *batch)
-    assert int(n_steps) == int(seq_state.step) == 3
+    final_state, last_total = scanned(state, *batch)
+    assert int(final_state.step) == int(seq_state.step) == 3
     # one fused scan program vs three separate programs: XLA reassociates
     # float reductions differently, so equality is semantic, not bitwise
     assert float(last_total) == pytest.approx(seq_losses[-1], rel=1e-3)
+    np.testing.assert_allclose(
+        jax.device_get(jax.tree.leaves(final_state.params)[0]),
+        jax.device_get(jax.tree.leaves(seq_state.params)[0]),
+        rtol=1e-4, atol=1e-6)
+
+
+def test_scanned_train_fn_donation_emits_no_warning():
+    """The timing harness donates its state (the production memory regime,
+    bench.py/scaling.py) and returns the final state so every donated
+    buffer has an aliasing target — jitting + running it must not emit
+    XLA's 'Some donated buffers were not usable' warning (visible in
+    BENCH_r05.json's tail before this contract)."""
+    import warnings
+
+    from real_time_helmet_detection_tpu.train import (make_scanned_train_fn,
+                                                      make_train_step_body)
+
+    cfg = tiny_cfg()
+    model, tx, state = make_state(cfg)
+    body = make_train_step_body(model, tx, cfg)
+    batch = tuple(jnp.asarray(a) for a in synthetic_batch())
+    scanned = jax.jit(make_scanned_train_fn(body, 2), donate_argnums=(0,))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        compiled = scanned.lower(state, *batch).compile()
+        float(compiled(state, *batch)[1])  # fetch only the scalar loss
+    donation_warnings = [w for w in caught
+                         if "donated buffers" in str(w.message)]
+    assert not donation_warnings, [str(w.message) for w in donation_warnings]
 
 
 def test_ckpt_interval(tmp_path):
