@@ -65,14 +65,20 @@ PEAK_BF16 = {
 }
 DEFAULT_PEAK = 1.97e14  # v5e — the BASELINE.json target chip
 
+# HBM bandwidth per chip (jax-ml scaling-book); used for roofline math by
+# scripts/roofline.py and scripts/mfu_breakdown.py (one table, shared).
+HBM_GBPS = {"v5e": 819e9, "v5 lite": 819e9, "v4": 1228e9, "v5p": 2765e9,
+            "v6e": 1640e9, "v6 lite": 1640e9, "trillium": 1640e9}
+DEFAULT_HBM = 819e9  # v5e
+
 # The artifacts/<round> directory every round-scoped script writes into.
 # ONE default, shared by quality_matrix.py, tpu_sweep.py, mfu_breakdown.py
 # and runner_drive.py (they diverged in r5: mfu_breakdown defaulted to r05
 # while the rest stayed at r04, scattering same-round artifacts — ADVICE
 # r5 #3); bump it here when a new round starts, or override per-run with
-# $GRAFT_ROUND. r06 = the process-loader round (ISSUE 1); earlier rounds'
+# $GRAFT_ROUND. r07 = the step-compression round (ISSUE 2); earlier rounds'
 # artifact dirs are committed history and must not be overwritten.
-GRAFT_ROUND_DEFAULT = "r06"
+GRAFT_ROUND_DEFAULT = "r07"
 
 
 def graft_round() -> str:
@@ -269,6 +275,39 @@ def flops_of(compiled) -> float | None:
         return None
 
 
+def bytes_of(compiled) -> float | None:
+    """'bytes accessed' from XLA cost analysis (None when the plugin does
+    not report it). Like flops, a scan/while body is counted ONCE
+    regardless of trip count (verified empirically: n=1 vs n=2 scans
+    differ by <3%), so a scanned N-step program's value reads as
+    per-step bytes."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        val = cost.get("bytes accessed")
+        # metric absent is expected on some plugins; do not route it
+        # through the blanket except meant for real cost-analysis failures
+        return float(val) if val is not None else None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def chain_timed_fetch(compiled, variables, images, overhead: float,
+                      repeats: int = 2):
+    """`timed_fetch` for image-donating predict chains: each call's final
+    carry (same aval/sharding as the input, content = input + O(1e-12))
+    becomes the next call's donated input, so repeats never touch a
+    deleted buffer and only the scalar crosses D2H."""
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        images, scalar = compiled(variables, images)
+        np.asarray(scalar)  # host fetch: forces real completion
+        best = min(best, time.perf_counter() - t0)
+    return max(best - overhead, 1e-9)
+
+
 def main() -> None:
     jax, devs = acquire_backend()
     import jax.numpy as jnp
@@ -332,7 +371,16 @@ def main() -> None:
     def make_predict_chain(n):
         """N sequential predicts in ONE program; each iteration's input
         depends (negligibly: +score*1e-12) on the previous output so XLA
-        cannot collapse or parallelize the chain."""
+        cannot collapse or parallelize the chain.
+
+        The image batch is DONATED and the final carry returned, so the
+        scan's carry aliases the input buffer instead of holding a second
+        image batch in HBM for the whole chain (the same contract
+        make_scanned_train_fn keeps for the train state — previously the
+        eval/predict program was the one remaining bench program that
+        failed to alias its inputs). Callers fetch ONLY the scalar and
+        thread the returned carry into the next timed call as the freshly
+        donated input (`chain_timed_fetch`)."""
         def prog(variables, images):
             def body(imgs, _):
                 det = predict(variables, imgs)
@@ -340,8 +388,8 @@ def main() -> None:
                     imgs.dtype)
                 return imgs + eps, ()
             final, _ = lax.scan(body, images, None, length=n)
-            return jnp.sum(final[0, 0, 0])
-        return jax.jit(prog)
+            return final, jnp.sum(final[0, 0, 0])
+        return jax.jit(prog, donate_argnums=(1,))
 
     # --- inference throughput (primary) + MFU(fwd) ------------------------
     try:
@@ -349,8 +397,9 @@ def main() -> None:
             (batch, imsize, imsize, 3)).astype(np.float32))
         compiled = make_predict_chain(n_inf).lower(variables, images).compile()
         chain_flops = flops_of(compiled)
-        np.asarray(compiled(variables, images))  # warmup
-        dt = timed_fetch(compiled, (variables, images), overhead)
+        images, s = compiled(variables, images)  # warmup (donates images;
+        np.asarray(s)  # the returned carry is the next call's input)
+        dt = chain_timed_fetch(compiled, variables, images, overhead)
         fps = batch * n_inf / dt
         out["value"] = round(fps, 2)
         out["n_scan"] = n_inf
@@ -371,8 +420,9 @@ def main() -> None:
         img1 = jnp.asarray(rng.standard_normal(
             (1, imsize, imsize, 3)).astype(np.float32))
         c1 = make_predict_chain(n_b1).lower(variables, img1).compile()
-        np.asarray(c1(variables, img1))
-        dt = timed_fetch(c1, (variables, img1), overhead)
+        img1, s1 = c1(variables, img1)  # warmup (donates img1)
+        np.asarray(s1)
+        dt = chain_timed_fetch(c1, variables, img1, overhead)
         out["latency_ms_b1"] = round(dt / n_b1 * 1e3, 3)
         log("batch-1 device latency: %.3f ms" % (dt / n_b1 * 1e3))
     except Exception as e:  # noqa: BLE001
@@ -383,9 +433,14 @@ def main() -> None:
         from real_time_helmet_detection_tpu.optim import build_optimizer
         from real_time_helmet_detection_tpu.train import (
             create_train_state, make_scanned_train_fn, make_train_step_body)
+        # step-compression knobs under A/B from the driver/chains:
+        # BENCH_REMAT={none,stacks,full}, BENCH_LOSS_KERNEL={auto,fused,xla}
         tcfg = Config(num_stack=1, hourglass_inch=128, num_cls=2,
                       batch_size=train_batch, amp=dtype is not None,
-                      imsize=imsize)
+                      imsize=imsize,
+                      remat=os.environ.get("BENCH_REMAT", "none"),
+                      loss_kernel=os.environ.get("BENCH_LOSS_KERNEL",
+                                                 "auto"))
         tmodel = build_model(tcfg, dtype=dtype)
         tx = build_optimizer(tcfg, 100)
         state = create_train_state(tmodel, tcfg, jax.random.key(0), imsize, tx)
@@ -398,6 +453,7 @@ def main() -> None:
         tcompiled = jax.jit(train_n, donate_argnums=(0,)).lower(
             state, *arrs).compile()
         train_flops = flops_of(tcompiled)
+        train_bytes = bytes_of(tcompiled)  # scan body counted once -> /step
         # warmup run consumes (donates) `state`; rebuild for the timed run.
         # The program returns (final state, last loss) so every donated
         # buffer has an output to alias (donation actually elides the
@@ -413,6 +469,12 @@ def main() -> None:
         if train_flops:
             # scan body counted once by cost analysis -> multiply by n_train
             out["mfu_train"] = round(train_flops * n_train / dt / peak, 4)
+        # why-MFU-moved context for the BENCH_rNN trajectory: the active
+        # step-compression settings + the step's cost-analysis HBM bytes
+        from real_time_helmet_detection_tpu.train import resolve_loss_kernel
+        out["hbm_bytes_per_step"] = train_bytes
+        out["remat"] = tcfg.remat
+        out["loss_kernel"] = resolve_loss_kernel(tcfg)
         out["mfu_peak_flops"] = peak
         out["mfu_peak_known"] = peak_known
         log("train: %.1f img/s/chip (%.2f ms/step)"

@@ -157,11 +157,24 @@ class Config:
     # next epoch's training (orbax AsyncCheckpointer). Single-host only;
     # transiently holds a second on-device copy of the train state, so
     # avoid when already at the HBM limit (e.g. --remat-sized configs)
-    remat: bool = False           # rematerialize hourglass stacks in bwd
+    remat: str = "none"           # activation rematerialization policy:
+    # "none" stores every activation; "stacks" recomputes each Hourglass
+    # stack in backward (nn.remat per stack — the pre-r7 --remat boolean,
+    # still accepted: True/False coerce to stacks/none); "full" wraps the
+    # WHOLE forward in jax.checkpoint(nothing_saveable) — max HBM savings
+    # (stem + neck + head activations too), max recompute. Trade FLOPs for
+    # HBM: the lever that fits batch 32/64 @512^2 and num-stack=4 @768^2.
+    # Numerically identical in all three modes (gradient-equality tested);
+    # param tree unchanged, so checkpoints are interchangeable.
+    loss_kernel: str = "auto"     # detection-loss implementation: "xla"
+    # (ops/loss.py reference composition), "fused" (one-pass Pallas
+    # sigmoid+focal+masked-L1 kernel with custom_vjp backward,
+    # ops/pallas/loss.py), "auto" = fused on TPU, xla elsewhere (same
+    # backend gating as the fused peak kernel). Off-TPU "fused" runs in
+    # (slow) interpret mode — test/debug only.
     stem_s2d: bool = False        # compute the 7x7 s2 stem conv in its
     # space-to-depth formulation (same arithmetic, MXU-friendlier
     # contraction; checkpoint-compatible either way)
-    # (trade FLOPs for HBM: fits num-stack=4 @ 768^2 batches)
     hang_warn_seconds: float = 300.0  # watchdog: warn when no train step
     # completes for this long (0 disables). Remote-TPU transports can
     # wedge mid-run; the reference has no failure detection at all.
@@ -196,6 +209,16 @@ class Config:
     # --no-summary disables). Shape inference only — no device compute.
 
     def __post_init__(self):
+        # pre-r7 compatibility: --remat was a boolean (Config(remat=True)
+        # in sweeps/tests); coerce to the policy vocabulary
+        if isinstance(self.remat, bool):
+            self.remat = "stacks" if self.remat else "none"
+        if self.remat not in ("none", "stacks", "full"):
+            raise ValueError("--remat must be one of none|stacks|full, "
+                             "got %r" % (self.remat,))
+        if self.loss_kernel not in ("auto", "fused", "xla"):
+            raise ValueError("--loss-kernel must be one of auto|fused|xla, "
+                             "got %r" % (self.loss_kernel,))
         if self.loader not in ("thread", "process"):
             raise ValueError("--loader must be 'thread' or 'process', got %r"
                              % self.loader)

@@ -74,7 +74,11 @@ def export_predict(cfg: Config, out_dir: Optional[str] = None,
     # frame, with the cast + normalization baked into the program
     in_dtype = jnp.uint8 if cfg.export_raw_input else jnp.float32
     spec = jax.ShapeDtypeStruct((batch_size, imsize, imsize, 3), in_dtype)
-    exported = jax.export.export(jax.jit(fn))(spec)
+    # explicit submodule import: on this jax (0.4.37) the `jax.export`
+    # ATTRIBUTE raises (deprecation module-getattr) until the submodule
+    # has been imported, which broke the export CLI on a fresh process
+    from jax import export as jax_export
+    exported = jax_export.export(jax.jit(fn))(spec)
 
     bin_path = os.path.join(out_dir, "exported_predict.bin")
     with open(bin_path, "wb") as f:
@@ -116,5 +120,7 @@ def export_predict(cfg: Config, out_dir: Optional[str] = None,
 
 def load_exported(bin_path: str):
     """Round-trip a serialized artifact back to a callable (Python side)."""
+    from jax import export as jax_export  # see export_predict: the
+    # attribute path raises until the submodule import has run
     with open(bin_path, "rb") as f:
-        return jax.export.deserialize(f.read())
+        return jax_export.deserialize(f.read())

@@ -335,7 +335,11 @@ class StackedHourglass(nn.Module):
     neck_pool: str = "None"
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
-    remat: bool = False  # rematerialize each Hourglass stack in backward
+    remat: Any = False  # "none"/False | "stacks"/True: rematerialize each
+    # Hourglass stack in backward. "full" is handled OUTSIDE the module
+    # (train.loss_fn wraps the whole apply in jax.checkpoint, covering the
+    # stem/neck/head too) — the module then stays plain so the recompute
+    # isn't doubly nested.
     stem_s2d: bool = False  # MXU-friendly space-to-depth stem conv
 
     @nn.compact
@@ -346,14 +350,14 @@ class StackedHourglass(nn.Module):
         x = PreLayer(mid_ch=128, out_ch=self.in_ch, activation=self.activation,
                      pool=self.pool, stem_s2d=self.stem_s2d, **kw)(x, train)
 
-        # --remat trades FLOPs for HBM: each stack's activations are
+        # --remat stacks trades FLOPs for HBM: each stack's activations are
         # recomputed during backward instead of stored — the lever that
         # fits num_stack=4 @ 768^2 batches in memory (BASELINE config #4);
         # numerically identical (tested). The explicit name keeps the param
         # tree identical to the plain model, so checkpoints are
-        # interchangeable between --remat and stored-activation runs.
-        HG = (nn.remat(Hourglass, static_argnums=(2,)) if self.remat
-              else Hourglass)
+        # interchangeable across every --remat policy.
+        HG = (nn.remat(Hourglass, static_argnums=(2,))
+              if self.remat in (True, "stacks") else Hourglass)
 
         predictions = []
         for i in range(self.num_stack):

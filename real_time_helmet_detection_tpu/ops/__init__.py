@@ -1,6 +1,7 @@
 from .encode import encode_boxes, encode_boxes_batch, encode_boxes_jax, gaussian_radius
 from .decode import decode_heatmap, decode_peak_scores, peak_mask
-from .loss import focal_loss, normed_l1_loss, detection_loss, LossLog
+from .loss import (focal_loss, normed_l1_loss, detection_loss, LossLog,
+                   split_stack_predictions, stacked_detection_loss)
 from .nms import nms_mask, soft_nms_mask
 
 __all__ = [
@@ -14,6 +15,8 @@ __all__ = [
     "focal_loss",
     "normed_l1_loss",
     "detection_loss",
+    "split_stack_predictions",
+    "stacked_detection_loss",
     "LossLog",
     "nms_mask",
     "soft_nms_mask",

@@ -70,6 +70,48 @@ def detection_loss(pred_heatmap: jax.Array, pred_offset: jax.Array, pred_size: j
     return {"hm": hm, "offset": off, "size": size, "total": total}
 
 
+def split_stack_predictions(out: jax.Array, num_cls: int,
+                            normalized_coord: bool):
+    """Split one stack's raw output (B, H, W, C+4) into post-activation
+    (heatmap, offset, size) as the reference does at ref train.py:105-119."""
+    heat = jax.nn.sigmoid(out[..., :num_cls])
+    offset = out[..., num_cls:num_cls + 2]
+    size = out[..., num_cls + 2:num_cls + 4]
+    if normalized_coord:
+        offset = jax.nn.sigmoid(offset)
+        size = jax.nn.sigmoid(size)
+    return heat, offset, size
+
+
+def stacked_detection_loss(out: jax.Array, gt_heat: jax.Array,
+                           gt_off: jax.Array, gt_wh: jax.Array,
+                           mask: jax.Array, *, num_cls: int,
+                           normalized_coord: bool = False,
+                           hm_weight: float = 1.0,
+                           offset_weight: float = 1.0,
+                           size_weight: float = 0.1,
+                           focal_alpha: float = 2.0,
+                           focal_beta: float = 4.0) -> Dict[str, jax.Array]:
+    """Deep-supervision loss over ALL stacks from the RAW model output
+    (B, S, H, W, C+4) — sigmoid + per-stack `detection_loss`, summed over
+    stacks (ref train.py:99-120). The XLA reference path; the Pallas
+    `ops.pallas.fused_detection_loss` is its one-pass twin (parity pinned
+    by tests/test_pallas_loss.py) selected via `--loss-kernel`."""
+    num_stack = out.shape[1]
+    totals = {"hm": 0.0, "offset": 0.0, "size": 0.0, "total": 0.0}
+    for s in range(num_stack):
+        heat, off, size = split_stack_predictions(out[:, s], num_cls,
+                                                  normalized_coord)
+        losses = detection_loss(
+            heat, off, size, gt_heat, gt_off, gt_wh, mask,
+            hm_weight=hm_weight, offset_weight=offset_weight,
+            size_weight=size_weight, focal_alpha=focal_alpha,
+            focal_beta=focal_beta)
+        for k in totals:
+            totals[k] = totals[k] + losses[k]
+    return totals
+
+
 class LossLog:
     """Host-side loss history (parity with LossCalculator.log, ref loss.py:9).
 

@@ -1,5 +1,7 @@
 """Pallas TPU kernels for the detection hot paths."""
 
+from .loss import fused_detection_loss, fused_stack_loss_sums
 from .peak import fused_peak_scores, peak_scores_reference
 
-__all__ = ["fused_peak_scores", "peak_scores_reference"]
+__all__ = ["fused_detection_loss", "fused_stack_loss_sums",
+           "fused_peak_scores", "peak_scores_reference"]

@@ -30,7 +30,7 @@ import json
 import os
 import time
 from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,7 +41,8 @@ from flax import struct
 from .config import Config, save_config
 from .data import BatchLoader, load_dataset
 from .models import build_model
-from .ops.loss import LossLog, detection_loss
+from .ops.loss import (LossLog, split_stack_predictions,
+                       stacked_detection_loss)
 from .optim import build_optimizer
 from .parallel import (batch_sharding, init_distributed, make_mesh,
                        replicated, shard_batch)
@@ -68,17 +69,8 @@ class TrainState(struct.PyTreeNode):
     ema_params: Any = None
 
 
-def split_stack_predictions(out: jax.Array, num_cls: int,
-                            normalized_coord: bool) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Split one stack's raw output (B, H, W, C+4) into post-activation
-    (heatmap, offset, size) as the reference does at ref train.py:105-119."""
-    heat = jax.nn.sigmoid(out[..., :num_cls])
-    offset = out[..., num_cls:num_cls + 2]
-    size = out[..., num_cls + 2:num_cls + 4]
-    if normalized_coord:
-        offset = jax.nn.sigmoid(offset)
-        size = jax.nn.sigmoid(size)
-    return heat, offset, size
+# split_stack_predictions moved to ops/loss.py (shared with both loss
+# implementations); re-exported above for compatibility.
 
 
 def init_variables(model, rng: jax.Array, imsize: int):
@@ -105,24 +97,47 @@ def create_train_state(model, cfg: Config, rng: jax.Array, imsize: int,
                       ema_params=ema)
 
 
+def resolve_loss_kernel(cfg: Config) -> str:
+    """'fused' | 'xla' for this backend: --loss-kernel auto selects the
+    Pallas fused loss on TPU only, exactly as the fused peak kernel is
+    gated (off-TPU it would run in slow interpret mode)."""
+    mode = getattr(cfg, "loss_kernel", "auto")
+    if mode == "auto":
+        return "fused" if jax.default_backend() == "tpu" else "xla"
+    return mode
+
+
 def loss_fn(params, batch_stats, model, images, gt_heat, gt_off, gt_wh, mask,
             cfg: Config):
-    """Forward + deep-supervision loss over all stacks (ref train.py:99-120)."""
-    out, mutated = model.apply(
-        {"params": params, "batch_stats": batch_stats}, images, train=True,
-        mutable=["batch_stats"])
-    num_stack = out.shape[1]
-    totals = {"hm": 0.0, "offset": 0.0, "size": 0.0, "total": 0.0}
-    for s in range(num_stack):
-        heat, off, size = split_stack_predictions(out[:, s], cfg.num_cls,
-                                                  cfg.normalized_coord)
-        losses = detection_loss(
-            heat, off, size, gt_heat, gt_off, gt_wh, mask,
-            hm_weight=cfg.hm_weight, offset_weight=cfg.offset_weight,
-            size_weight=cfg.size_weight, focal_alpha=cfg.focal_alpha,
-            focal_beta=cfg.focal_beta)
-        for k in totals:
-            totals[k] = totals[k] + losses[k]
+    """Forward + deep-supervision loss over all stacks (ref train.py:99-120).
+
+    Two step-compression levers hook in here (both numerically pinned by
+    tests): `--remat full` wraps the WHOLE forward in
+    `jax.checkpoint(nothing_saveable)` — backward recomputes every
+    activation (stem/neck/head included, beyond what the in-model
+    per-stack nn.remat covers) so batch 32/64 @512^2 fits HBM; and
+    `--loss-kernel` picks the XLA loss composition or the one-pass Pallas
+    fused kernel (ops/pallas/loss.py)."""
+    def apply_fn(p, bs, im):
+        return model.apply({"params": p, "batch_stats": bs}, im,
+                           train=True, mutable=["batch_stats"])
+
+    if getattr(cfg, "remat", "none") == "full":
+        apply_fn = jax.checkpoint(
+            apply_fn, policy=jax.checkpoint_policies.nothing_saveable)
+    out, mutated = apply_fn(params, batch_stats, images)
+    kw = dict(hm_weight=cfg.hm_weight, offset_weight=cfg.offset_weight,
+              size_weight=cfg.size_weight, focal_alpha=cfg.focal_alpha,
+              focal_beta=cfg.focal_beta)
+    if resolve_loss_kernel(cfg) == "fused":
+        from .ops.pallas import fused_detection_loss
+        totals = fused_detection_loss(
+            out, gt_heat, gt_off, gt_wh, mask,
+            normalized_coord=cfg.normalized_coord, **kw)
+    else:
+        totals = stacked_detection_loss(
+            out, gt_heat, gt_off, gt_wh, mask, num_cls=cfg.num_cls,
+            normalized_coord=cfg.normalized_coord, **kw)
     return totals["total"], (mutated.get("batch_stats", batch_stats), totals)
 
 

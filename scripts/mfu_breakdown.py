@@ -51,9 +51,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import (DEFAULT_PEAK, PEAK_BF16, acquire_backend,
-                   find_last_tpu_result, flops_of, graft_round, log,
-                   measure_dispatch_overhead, timed_fetch)
+from bench import (DEFAULT_HBM, DEFAULT_PEAK, HBM_GBPS, PEAK_BF16,
+                   acquire_backend, bytes_of, find_last_tpu_result,
+                   flops_of, graft_round, log, measure_dispatch_overhead,
+                   timed_fetch)
 
 ANALYTIC = "--analytic" in sys.argv
 
@@ -85,23 +86,9 @@ def measured_train_anchor():
 
 MEASURED_STEP_MS, MEASURED_MFU, MEASURED_SRC = measured_train_anchor()
 
-# v5e HBM bandwidth (jax-ml scaling-book): ~819 GB/s.
-HBM_GBPS = {"v5e": 819e9, "v5 lite": 819e9, "v4": 1228e9, "v5p": 2765e9,
-            "v6e": 1640e9, "v6 lite": 1640e9, "trillium": 1640e9}
-DEFAULT_HBM = 819e9
-
-
-def bytes_of(compiled) -> float | None:
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        val = cost.get("bytes accessed")
-        # metric absent is expected on some plugins; do not route it
-        # through the blanket except meant for real cost-analysis failures
-        return float(val) if val is not None else None
-    except Exception:  # noqa: BLE001
-        return None
+# HBM-bandwidth table and bytes_of moved to bench.py (r7): one shared
+# definition for this script, bench.py's hbm_bytes_per_step field and
+# scripts/roofline.py's per-fusion roofline.
 
 
 def main() -> None:

@@ -1,0 +1,578 @@
+"""Per-fusion roofline attribution for the train step (ISSUE 2 tentpole).
+
+bench.py's `mfu_train` says WHAT fraction of peak the step achieves;
+nothing said WHERE the rest goes. This tool grows scripts/trace_summary.py
+into a roofline attributor: it compiles the production scanned train step
+(the exact program bench.py times), then
+
+1. parses the compiled HLO text (`jax.stages.Compiled.as_text()`) into a
+   per-instruction table — HBM bytes (operand + result buffer sizes: what
+   a fusion actually moves, ignoring VMEM-resident intra-fusion
+   temporaries), analytic FLOPs (convolution/dot shape math, attributed
+   through `calls=`d fused computations; elementwise/reduce ops counted at
+   1 FLOP/element and labeled approximate),
+2. optionally executes the program under `jax.profiler` and joins the
+   device trace's per-op durations (trace_summary.op_durations) by exact
+   instruction name,
+3. classifies every op against the v5e roofline: arithmetic intensity
+   (FLOPs/byte) vs the ridge point peak_flops / hbm_bw (~241 FLOP/byte on
+   v5e) -> bound-by "mxu" | "hbm", plus each op's % of step time and of
+   step bytes,
+
+and writes `artifacts/<round>/roofline/` (round from bench.graft_round())
+as machine-readable JSON (schema "roofline-v1", guarded by
+tests/test_roofline.py) plus a human markdown table — so every future perf
+PR starts from measured targets instead of vibes.
+
+`--ab-loss-kernel` additionally compiles the --loss-kernel xla/fused
+variants of the same config and records the cost-analysis byte/FLOP deltas
+(full step AND loss-only subprogram) — the ISSUE-2 acceptance evidence.
+
+Off-chip honesty: on the CPU backend the per-op BYTES reflect the CPU
+pipeline's fusion/layout choices (a proxy for TPU's — r5's analytic
+roofline showed CPU bytes can overestimate chip traffic severely for
+convolutions), and times are host times; the artifact labels its platform
+and the v5e constants it classifies against. When the chip is reachable,
+run exactly the same command behind the single claim waiter (CLAUDE.md).
+
+Usage:
+  python scripts/roofline.py [--platform cpu] [--batch N] [--imsize N]
+      [--steps N] [--remat none|stacks|full] [--loss-kernel auto|fused|xla]
+      [--num-stack N] [--top N] [--no-trace] [--ab-loss-kernel]
+      [--out PATH.json] [--tag TAG]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import (DEFAULT_HBM, DEFAULT_PEAK, HBM_GBPS, PEAK_BF16,
+                   acquire_backend, bytes_of, flops_of, graft_round, log)
+
+SCHEMA = "roofline-v1"
+
+# dtype -> bytes per element (HLO shape literals)
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_INSTR_RE = re.compile(r"^\s+(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.+)$")
+# greedy param match: computation params can be tuple-typed (nested
+# parens — while-body regions), so anchor on the LAST ') ->'
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->")
+_CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
+_APPLY_RE = re.compile(r"to_apply=%?([\w.\-]+)")
+_WINDOW_RE = re.compile(r"window={[^}]*\bsize=([0-9x]+)")
+_GROUPS_RE = re.compile(r"feature_group_count=(\d+)")
+_DIMLBL_RE = re.compile(r"dim_labels=([\w?]+)_([\w?]+)->")
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims={([0-9,]*)}")
+
+# non-compute plumbing: never reported as roofline rows
+_SKIP_OPCODES = {
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "while", "conditional", "call", "after-all", "add-dependency",
+    "partition-id", "replica-id", "rng-get-and-update-state", "domain",
+    "opt-barrier", "get-dimension-size",
+}
+
+# 1-FLOP/element opcodes (the approximate elementwise/reduce estimate;
+# transcendentals deliberately also 1/elem — byte-bound ops don't turn on
+# their FLOP count)
+_ELEMENTWISE_HINT = {
+    "add", "subtract", "multiply", "divide", "maximum", "minimum", "abs",
+    "negate", "exponential", "log", "tanh", "logistic", "power", "sqrt",
+    "rsqrt", "select", "compare", "convert", "floor", "ceil", "sign",
+    "and", "or", "not", "xor", "clamp", "reduce", "reduce-window",
+    "exponential-minus-one", "log-plus-one", "remainder", "atan2",
+}
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    bpe = _DTYPE_BYTES.get(dtype)
+    if bpe is None:
+        return 0  # token/opaque/tuple-internal — no buffer
+    n = 1
+    if dims:
+        for d in dims.split(","):
+            n *= int(d)
+    return n * bpe
+
+
+def _shape_elems(dims: str) -> int:
+    n = 1
+    if dims:
+        for d in dims.split(","):
+            n *= int(d)
+    return n
+
+
+class Instr:
+    __slots__ = ("name", "opcode", "out_bytes", "operand_bytes",
+                 "out_elems", "flops", "calls", "line")
+
+    def __init__(self, name, opcode, out_bytes, operand_bytes, out_elems,
+                 flops, calls, line):
+        self.name = name
+        self.opcode = opcode
+        self.out_bytes = out_bytes
+        self.operand_bytes = operand_bytes
+        self.out_elems = out_elems
+        self.flops = flops
+        self.calls = calls
+        self.line = line
+
+
+def _parse_rhs(rhs: str):
+    """(result_part, opcode, rest) of an instruction's right-hand side."""
+    rhs = rhs.strip()
+    if rhs.startswith("("):  # tuple result: shapes up to the matching ')'
+        depth = 0
+        for i, ch in enumerate(rhs):
+            depth += ch == "("
+            depth -= ch == ")"
+            if depth == 0:
+                break
+        result, rest = rhs[:i + 1], rhs[i + 1:]
+    else:
+        sp = rhs.find(" ")
+        result, rest = rhs[:sp], rhs[sp:]
+    rest = rest.strip()
+    m = re.match(r"([\w\-]+)", rest)
+    opcode = m.group(1) if m else "?"
+    return result, opcode, rest[len(opcode):]
+
+
+def _conv_flops(line: str, out_elems: int) -> float:
+    """2 * out_elems * window_prod * per-group input channels."""
+    win = _WINDOW_RE.search(line)
+    wprod = 1
+    if win:
+        for s in win.group(1).split("x"):
+            wprod *= int(s)
+    cin = 1
+    dl = _DIMLBL_RE.search(line)
+    # operand 1 (the kernel) is the second shape in the call parens
+    shapes = _SHAPE_RE.findall(line.split("convolution(", 1)[-1])
+    if dl and len(shapes) >= 2:
+        klabels = dl.group(2)
+        kdims = shapes[1][1].split(",") if shapes[1][1] else []
+        ipos = klabels.find("i")
+        if 0 <= ipos < len(kdims):
+            cin = int(kdims[ipos])
+    return 2.0 * out_elems * wprod * cin
+
+
+def _dot_flops(line: str, out_elems: int) -> float:
+    m = _CONTRACT_RE.search(line)
+    shapes = _SHAPE_RE.findall(line.split("dot(", 1)[-1])
+    contract = 1
+    if m and shapes:
+        lhs_dims = shapes[0][1].split(",") if shapes[0][1] else []
+        for idx in (m.group(1).split(",") if m.group(1) else []):
+            i = int(idx)
+            if i < len(lhs_dims):
+                contract *= int(lhs_dims[i])
+    return 2.0 * out_elems * contract
+
+
+def _instr_flops(opcode: str, line: str, out_elems: int) -> float:
+    if opcode == "convolution":
+        return _conv_flops(line, out_elems)
+    if opcode == "dot":
+        return _dot_flops(line, out_elems)
+    if opcode in _ELEMENTWISE_HINT:
+        return float(out_elems)
+    return 0.0
+
+
+def parse_hlo(text: str):
+    """HLO module text -> {computation_name: [Instr, ...]}, plus the sets
+    of computations called as fusion bodies / scalar appliers (to roll up
+    or skip when selecting reportable rows)."""
+    comps = {}
+    fusion_bodies = set()
+    appliers = set()
+    current = None
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        if not line:
+            continue
+        if not line.startswith(" ") and "{" in line:
+            m = _COMP_RE.match(line)
+            # a header that fails the name parse still ends the previous
+            # computation — misfiling its instructions into an excluded
+            # fusion body would silently drop them from the table
+            current = m.group(1) if m else "_comp_%d" % len(comps)
+            comps[current] = []
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        m = _INSTR_RE.match(line)
+        if m is None or current is None:
+            continue
+        name, rhs = m.group(1), m.group(2)
+        # cut trailing annotation blocks whose payload can contain
+        # bracketed text that would pollute the operand-shape scan
+        body = re.split(r",\s*(?:metadata=|backend_config=|sharding=)",
+                        rhs)[0]
+        result, opcode, rest = _parse_rhs(body)
+        out_shapes = _SHAPE_RE.findall(result)
+        out_bytes = sum(_shape_bytes(d, s) for d, s in out_shapes)
+        out_elems = sum(_shape_elems(s) for _, s in out_shapes)
+        opnd_bytes = sum(_shape_bytes(d, s)
+                         for d, s in _SHAPE_RE.findall(rest))
+        calls = None
+        if opcode == "fusion":
+            cm = _CALLS_RE.search(rest)
+            if cm:
+                calls = cm.group(1)
+                fusion_bodies.add(calls)
+        am = _APPLY_RE.search(rest)
+        if am:
+            appliers.add(am.group(1))
+        flops = _instr_flops(opcode, body, out_elems)
+        comps[current].append(Instr(name, opcode, out_bytes, opnd_bytes,
+                                    out_elems, flops, calls, body))
+    return comps, fusion_bodies, appliers
+
+
+def attribute(comps, fusion_bodies, appliers):
+    """Reportable per-op records: every instruction of every computation
+    that is not a fusion body or scalar applier, with fusion FLOPs rolled
+    up from their called computations."""
+    comp_flops = {
+        cname: sum(i.flops for i in instrs)
+        for cname, instrs in comps.items()
+    }
+    rows = []
+    for cname, instrs in comps.items():
+        if cname in fusion_bodies or cname in appliers:
+            continue
+        for i in instrs:
+            if i.opcode in _SKIP_OPCODES:
+                continue
+            flops = i.flops
+            kind = i.opcode
+            if i.opcode == "fusion" and i.calls:
+                flops = comp_flops.get(i.calls, 0.0)
+            bytes_ = i.out_bytes + i.operand_bytes
+            if bytes_ == 0 and flops == 0:
+                continue
+            rows.append({"name": i.name, "opcode": kind,
+                         "flops": flops, "bytes": float(bytes_)})
+    return rows
+
+
+def classify(rows, peak: float, hbm: float, durations=None, steps: int = 1):
+    """Fill intensity / bound / %s into `rows`; returns summary totals."""
+    ridge = peak / hbm
+    matched_us = 0.0
+    for r in rows:
+        dur = durations.get(r["name"]) if durations else None
+        if dur is not None:
+            r["time_us"] = round(dur[0] / steps, 3)
+            r["trace_calls"] = dur[1]
+            matched_us += dur[0]
+        else:
+            r["time_us"] = None
+        b = r["bytes"]
+        f = r["flops"]
+        r["intensity"] = round(f / b, 3) if b else math.inf
+        r["bound"] = "mxu" if (b == 0 or f / b >= ridge) else "hbm"
+        # the roofline-implied floor for this op alone, at target-chip
+        # constants (µs)
+        r["t_roofline_us"] = round(max(f / peak, b / hbm) * 1e6, 3)
+    total_bytes = sum(r["bytes"] for r in rows) or 1.0
+    total_time = sum(r["time_us"] for r in rows
+                     if r["time_us"] is not None) or None
+    for r in rows:
+        r["pct_bytes"] = round(100.0 * r["bytes"] / total_bytes, 2)
+        r["pct_time"] = (round(100.0 * r["time_us"] / total_time, 2)
+                         if total_time and r["time_us"] is not None
+                         else None)
+    rows.sort(key=lambda r: (-(r["time_us"] or 0.0), -r["bytes"]))
+    return {"total_bytes": total_bytes,
+            "total_time_us_per_step": total_time,
+            "ridge_flops_per_byte": round(peak / hbm, 2),
+            "matched_trace_us": round(matched_us, 1)}
+
+
+def _markdown(rows, meta, top: int) -> str:
+    lines = ["# Roofline attribution — train step",
+             "",
+             "platform=%s  config=%s" % (meta["platform"],
+                                         json.dumps(meta["config"])),
+             "ridge=%.1f FLOP/byte (v5e %.0f TFLOP/s / %.0f GB/s)"
+             % (meta["summary"]["ridge_flops_per_byte"],
+                meta["peak_flops"] / 1e12, meta["hbm_bytes_per_s"] / 1e9),
+             "",
+             "| op | kind | time us/step | % time | MB | % bytes | "
+             "GFLOP | FLOP/byte | bound |",
+             "|---|---|---|---|---|---|---|---|---|"]
+    for r in rows[:top]:
+        lines.append(
+            "| %s | %s | %s | %s | %.2f | %.1f | %.2f | %s | %s |" % (
+                r["name"][:48], r["opcode"],
+                "%.1f" % r["time_us"] if r["time_us"] is not None else "-",
+                "%.1f" % r["pct_time"] if r["pct_time"] is not None else "-",
+                r["bytes"] / 2**20, r["pct_bytes"], r["flops"] / 1e9,
+                "inf" if r["intensity"] == math.inf else
+                "%.1f" % r["intensity"], r["bound"]))
+    return "\n".join(lines) + "\n"
+
+
+def build_step(jax, args, loss_kernel: str):
+    """The exact scanned train program bench.py times, at the CLI config."""
+    import jax.numpy as jnp
+
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.data import synthetic_target_batch
+    from real_time_helmet_detection_tpu.models import build_model
+    from real_time_helmet_detection_tpu.optim import build_optimizer
+    from real_time_helmet_detection_tpu.train import (
+        create_train_state, make_scanned_train_fn, make_train_step_body)
+
+    cfg = Config(num_stack=args.num_stack, hourglass_inch=args.hourglass_inch,
+                 num_cls=2, batch_size=args.batch, amp=True,
+                 imsize=args.imsize, remat=args.remat,
+                 loss_kernel=loss_kernel)
+    model = build_model(cfg, dtype=jnp.bfloat16)
+    tx = build_optimizer(cfg, 100)
+    state = create_train_state(model, cfg, jax.random.key(0), args.imsize,
+                               tx)
+    body = make_train_step_body(model, tx, cfg)
+    arrs = tuple(jnp.asarray(a) for a in synthetic_target_batch(
+        args.batch, args.imsize, pos_rate=0.01))
+    train_n = make_scanned_train_fn(body, args.steps)
+    compiled = jax.jit(train_n, donate_argnums=(0,)).lower(
+        state, *arrs).compile()
+    remake = lambda: create_train_state(  # noqa: E731 — donation refills
+        model, cfg, jax.random.key(0), args.imsize, tx)
+    return compiled, state, arrs, remake
+
+
+def loss_subprogram_cost(jax, args, kernel: str):
+    """Cost record of value_and_grad of the loss ALONE over the raw stack
+    output at the CLI shapes — the fusion the Pallas kernel replaces,
+    isolated from the conv-dominated step.
+
+    Returns {flops, bytes (XLA cost analysis), parsed_bytes (this file's
+    operand+result model over the compiled HLO), kernel_bytes_analytic
+    (fused only)}. Counting-model caveat: OFF-TPU the fused variant
+    compiles the Pallas INTERPRET lowering (dynamic-update-slice
+    machinery that does not exist on chip), so its compiled-artifact byte
+    counts are meaningless there; `kernel_bytes_analytic` applies the
+    SAME operand+result rule to the real TPU lowering's shape — fwd reads
+    the five input maps, bwd reads them again and writes d(out) — and is
+    the honest comparison partner for the XLA variant's parsed_bytes."""
+    import jax.numpy as jnp
+
+    from real_time_helmet_detection_tpu.data import synthetic_target_batch
+    from real_time_helmet_detection_tpu.ops.loss import (
+        stacked_detection_loss)
+    from real_time_helmet_detection_tpu.ops.pallas import (
+        fused_detection_loss)
+
+    _, heat, off, wh, mask = (jnp.asarray(a) for a in
+                              synthetic_target_batch(args.batch,
+                                                     args.imsize,
+                                                     pos_rate=0.01))
+    m = args.imsize // 4
+    rng = np.random.default_rng(0)
+    out = jnp.asarray(rng.standard_normal(
+        (args.batch, args.num_stack, m, m, 6)).astype(np.float32))
+
+    if kernel == "fused":
+        fn = lambda o: fused_detection_loss(  # noqa: E731
+            o, heat, off, wh, mask)["total"]
+    else:
+        fn = lambda o: stacked_detection_loss(  # noqa: E731
+            o, heat, off, wh, mask, num_cls=2)["total"]
+    c = jax.jit(jax.value_and_grad(fn)).lower(out).compile()
+    comps, fb, ap = parse_hlo(c.as_text())
+    rec = {"flops": flops_of(c), "bytes": bytes_of(c),
+           "parsed_bytes": sum(r["bytes"]
+                               for r in attribute(comps, fb, ap))}
+    if kernel == "fused":
+        inputs = sum(float(a.size) * a.dtype.itemsize
+                     for a in (out, heat, off, wh, mask))
+        # fwd pass reads + bwd pass reads + d(out) write (+ the tiny
+        # epilogue re-reads mask for num_pos)
+        rec["kernel_bytes_analytic"] = (
+            2.0 * inputs + float(out.size) * out.dtype.itemsize
+            + float(mask.size) * mask.dtype.itemsize)
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform (cpu/tpu); '' = default")
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--imsize", type=int, default=512)
+    ap.add_argument("--num-stack", type=int, default=1)
+    ap.add_argument("--hourglass-inch", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=2,
+                    help="scan length of the traced program")
+    ap.add_argument("--remat", default="none",
+                    choices=["none", "stacks", "full"])
+    ap.add_argument("--loss-kernel", default="auto",
+                    choices=["auto", "fused", "xla"])
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--no-trace", action="store_true",
+                    help="skip the profiler run (cost-only attribution)")
+    ap.add_argument("--ab-loss-kernel", action="store_true",
+                    help="also compile the xla/fused loss variants and "
+                         "record the byte/FLOP deltas")
+    ap.add_argument("--out", default="",
+                    help="output JSON path (default: artifacts/<round>/"
+                         "roofline/roofline_<platform>[_<tag>].json)")
+    ap.add_argument("--tag", default="")
+    ap.add_argument("--cpu", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+        devs = jax.devices()
+    else:
+        # full acquire (probe subprocess + retries); never silently CPU —
+        # an accidental CPU artifact would masquerade as chip attribution
+        jax, devs = acquire_backend(allow_cpu_fallback=args.cpu)
+        import jax  # noqa: F811 — name for the helpers below
+
+    platform = devs[0].platform
+    device_kind = getattr(devs[0], "device_kind", "unknown")
+    peak, hbm = DEFAULT_PEAK, DEFAULT_HBM
+    for key, val in PEAK_BF16.items():
+        if key in device_kind.lower():
+            peak, hbm = val, HBM_GBPS.get(key, DEFAULT_HBM)
+            break
+    log("backend: %s (%s); classifying against %.0f TFLOP/s / %.0f GB/s"
+        % (device_kind, platform, peak / 1e12, hbm / 1e9))
+
+    compiled, state, arrs, remake = build_step(jax, args, args.loss_kernel)
+    total_flops, total_bytes_ca = flops_of(compiled), bytes_of(compiled)
+    comps, fusion_bodies, appliers = parse_hlo(compiled.as_text())
+    rows = attribute(comps, fusion_bodies, appliers)
+    log("HLO: %d computations, %d reportable ops"
+        % (len(comps), len(rows)))
+
+    durations = None
+    trace_note = "disabled (--no-trace)"
+    if not args.no_trace:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from trace_summary import find_traces, load_events, op_durations
+        import tempfile
+        tdir = tempfile.mkdtemp(prefix="roofline_trace_")
+        try:
+            np.asarray(compiled(state, *arrs)[1])  # warmup (donates state)
+            st2 = remake()
+            jax.profiler.start_trace(tdir)
+            np.asarray(compiled(st2, *arrs)[1])
+            jax.profiler.stop_trace()
+            events = []
+            for t in find_traces(tdir):
+                events += load_events(t)
+            durations = op_durations(events)
+            trace_note = "%d named trace ops" % len(durations)
+        except Exception as e:  # noqa: BLE001 — plugin support varies
+            trace_note = "trace failed: %s" % str(e).splitlines()[-1][:200]
+            log(trace_note)
+
+    summary = classify(rows, peak, hbm, durations, steps=args.steps)
+    meta = {
+        "schema": SCHEMA,
+        "platform": platform,
+        "device_kind": device_kind,
+        "peak_flops": peak,
+        "hbm_bytes_per_s": hbm,
+        "config": {"batch": args.batch, "imsize": args.imsize,
+                   "num_stack": args.num_stack, "steps": args.steps,
+                   "remat": args.remat, "loss_kernel": args.loss_kernel,
+                   "amp": True},
+        "totals": {"flops": total_flops,
+                   "cost_analysis_bytes": total_bytes_ca,
+                   "parsed_bytes": summary["total_bytes"]},
+        "trace": trace_note,
+        "summary": summary,
+        "note": ("bytes are operand+result buffer sizes of the optimized "
+                 "HLO's reportable ops (fusion-internal temporaries "
+                 "excluded); on cpu they reflect the host pipeline's "
+                 "fusion choices — a proxy for the TPU compiler's"),
+    }
+
+    if args.ab_loss_kernel:
+        ab = {}
+        for variant in ("xla", "fused"):
+            c, _, _, _ = build_step(jax, args, variant)
+            ab["step_%s" % variant] = {"flops": flops_of(c),
+                                       "bytes": bytes_of(c)}
+            ab["loss_only_%s" % variant] = loss_subprogram_cost(
+                jax, args, variant)
+        # Honest pairing per platform (see loss_subprogram_cost): the XLA
+        # variant's parsed bytes vs the fused kernel's — parsed on TPU
+        # (the custom-call is transparent to the operand+result model),
+        # analytic off-TPU (the interpret lowering is not the kernel).
+        lx = ab["loss_only_xla"]["parsed_bytes"]
+        fused_rec = ab["loss_only_fused"]
+        lf_ = (fused_rec["parsed_bytes"] if platform == "tpu"
+               else fused_rec["kernel_bytes_analytic"])
+        ab["fused_bytes_basis"] = ("parsed" if platform == "tpu"
+                                   else "analytic")
+        if lx and lf_:
+            ab["loss_bytes_delta_pct"] = round(100.0 * (lx - lf_) / lx, 2)
+        # projected FULL-step reduction from the loss fusion alone, on the
+        # same counting model (the conv-dominated step dilutes it hard —
+        # the attribution table above is the evidence of where bytes
+        # actually go)
+        if lx and lf_ and summary["total_bytes"]:
+            ab["step_bytes_delta_pct_projected"] = round(
+                100.0 * (lx - lf_) / summary["total_bytes"], 3)
+        sx, sf = ab["step_xla"]["bytes"], ab["step_fused"]["bytes"]
+        if sx and sf and platform == "tpu":
+            # meaningful only where the fused step compiles the real
+            # kernel, not the interpret lowering
+            ab["step_bytes_delta_pct_cost_analysis"] = round(
+                100.0 * (sx - sf) / sx, 2)
+        meta["loss_kernel_ab"] = ab
+        log("loss-kernel A/B: %s" % json.dumps(
+            {k: v for k, v in ab.items() if "pct" in k or "basis" in k}))
+
+    meta["fusions"] = rows
+    if args.out:
+        out_path = args.out
+    else:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        tag = ("_" + args.tag) if args.tag else ""
+        out_path = os.path.join(root, "artifacts", graft_round(),
+                                "roofline",
+                                "roofline_%s%s.json" % (platform, tag))
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(meta, f, indent=1)
+    md_path = out_path.rsplit(".", 1)[0] + ".md"
+    with open(md_path, "w") as f:
+        f.write(_markdown(rows, meta, args.top))
+    log("wrote %s (+ %s)" % (out_path, os.path.basename(md_path)))
+    # one JSON line on stdout (repo convention), without the full table
+    print(json.dumps({k: v for k, v in meta.items() if k != "fusions"}
+                     | {"n_ops": len(rows), "out": out_path}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,4 @@
-"""Single-chip TPU sweep: batch-size scaling, num_stack=2, remat analysis.
+"""Single-chip TPU sweep: batch scaling, num_stack=2, remat, step grid.
 
 Completes the round-2 experiment matrix that the tunnel outage interrupted
 (artifacts/r02/README.md §7): how throughput and MFU scale with batch size
@@ -17,7 +17,14 @@ artifacts/<round>/sweep.json (round from $GRAFT_ROUND, default
 bench.GRAFT_ROUND_DEFAULT — one constant for every round-scoped script) after
 every single config — a killed run loses at most the in-flight config —
 and `--only <section>[,<section>]` reruns just the missing sections
-(inference, train, stack2, remat, stack4_768).
+(inference, train, stack2, remat, stack4_768, step_grid).
+
+`step_grid` (ISSUE 2) is the (batch x remat x loss-kernel) matrix that
+picks the step-compression default: batches {16, 32, 64} x --remat
+{none, stacks, full} x --loss-kernel {xla, fused}, flagship 512^2
+num_stack=1 bf16. The record with the best img/s that compiled lands in
+`step_grid_selected`. On-chip etiquette: queue this behind the single
+claim waiter (CLAUDE.md); each config flushes before the next compiles.
 """
 
 from __future__ import annotations
@@ -31,8 +38,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import (DEFAULT_PEAK, PEAK_BF16, acquire_backend, flops_of,
-                   graft_round, log, measure_dispatch_overhead, timed_fetch)
+from bench import (DEFAULT_PEAK, PEAK_BF16, acquire_backend,
+                   chain_timed_fetch, flops_of, graft_round, log,
+                   measure_dispatch_overhead, timed_fetch)
 
 
 def memory_analysis_of(compiled):
@@ -62,7 +70,7 @@ OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
 SECTION_KEYS = {"inference": "inference_batch_sweep",
                 "train": "train_batch_sweep",
                 "stack2": "num_stack2", "remat": "remat",
-                "stack4_768": "stack4_768"}
+                "stack4_768": "stack4_768", "step_grid": "step_grid"}
 
 
 def merge_prior(results: dict, prior: dict, only: set) -> dict:
@@ -83,7 +91,13 @@ def merge_prior(results: dict, prior: dict, only: set) -> dict:
             % (prior.get("platform"), results.get("platform")))
     for sec, k in SECTION_KEYS.items():
         if sec not in only:
-            results[k] = prior.get(k, results[k])
+            if k in prior:
+                results[k] = prior[k]
+            # else: prior predates this section (older sweep.json) — keep
+            # the fresh empty value, if the caller's dict has one at all
+            if sec == "step_grid" and "step_grid_selected" in prior:
+                # the derived pick rides with its section
+                results["step_grid_selected"] = prior["step_grid_selected"]
     return results
 
 
@@ -133,7 +147,7 @@ def main() -> None:
         "platform": platform, "device_kind": device_kind, "imsize": imsize,
         "dispatch_ms": round(overhead * 1e3, 3),
         "inference_batch_sweep": [], "train_batch_sweep": [],
-        "num_stack2": {}, "remat": [], "stack4_768": [],
+        "num_stack2": {}, "remat": [], "stack4_768": [], "step_grid": [],
     }
     def read_prior(path):
         """Prior results at `path`, or None if absent/unreadable — a kill
@@ -190,6 +204,9 @@ def main() -> None:
         return only is None or section in only
 
     def predict_chain(predict, n):
+        # donates the image batch and returns the final carry as its
+        # aliasing target (bench.py's make_predict_chain contract — no
+        # second image buffer held, no donation warning)
         def prog(variables, images):
             def body(imgs, _):
                 det = predict(variables, imgs)
@@ -197,8 +214,8 @@ def main() -> None:
                     imgs.dtype)
                 return imgs + eps, ()
             final, _ = lax.scan(body, images, None, length=n)
-            return jnp.sum(final[0, 0, 0])
-        return jax.jit(prog)
+            return final, jnp.sum(final[0, 0, 0])
+        return jax.jit(prog, donate_argnums=(1,))
 
     def bench_inference(num_stack, batch, n):
         cfg = Config(num_stack=num_stack, hourglass_inch=128, num_cls=2,
@@ -214,8 +231,9 @@ def main() -> None:
             variables, images).compile()
         compile_s = time.perf_counter() - t0
         fl = flops_of(compiled)
-        np.asarray(compiled(variables, images))  # warmup
-        dt = timed_fetch(compiled, (variables, images), overhead)
+        images, s = compiled(variables, images)  # warmup (donates images)
+        np.asarray(s)
+        dt = chain_timed_fetch(compiled, variables, images, overhead)
         rec = {"batch": batch, "img_per_sec": round(batch * n / dt, 1),
                "ms_per_batch": round(dt / n * 1e3, 3),
                "compile_s": round(compile_s, 1)}
@@ -223,10 +241,12 @@ def main() -> None:
             rec["mfu_fwd"] = round(fl * n / dt / peak, 4)
         return rec
 
-    def bench_train(num_stack, batch, n, remat, imsize_=None):
+    def bench_train(num_stack, batch, n, remat, imsize_=None,
+                    loss_kernel="auto"):
         sz = imsize_ or imsize
         cfg = Config(num_stack=num_stack, hourglass_inch=128, num_cls=2,
-                     batch_size=batch, amp=True, imsize=sz, remat=remat)
+                     batch_size=batch, amp=True, imsize=sz, remat=remat,
+                     loss_kernel=loss_kernel)
         model = build_model(cfg, dtype=jnp.bfloat16)
         tx = build_optimizer(cfg, 100)
         state = create_train_state(model, cfg, jax.random.key(0), sz, tx)
@@ -246,13 +266,19 @@ def main() -> None:
         # give the donated input an aliasing target, not to be fetched
         dt = timed_fetch(lambda *a: compiled(*a)[1], (state, *arrs),
                          overhead, repeats=1)
-        rec = {"batch": batch, "remat": remat, "imsize": sz,
+        from real_time_helmet_detection_tpu.train import resolve_loss_kernel
+        from bench import bytes_of
+        rec = {"batch": batch, "remat": cfg.remat, "imsize": sz,
                "num_stack": num_stack,
+               "loss_kernel": resolve_loss_kernel(cfg),
                "img_per_sec_chip": round(batch * n / dt, 1),
                "step_ms": round(dt / n * 1e3, 3),
                "compile_s": round(compile_s, 1)}
         if fl:
             rec["mfu_train"] = round(fl * n / dt / peak, 4)
+        hbm_bytes = bytes_of(compiled)
+        if hbm_bytes:
+            rec["hbm_bytes_per_step"] = hbm_bytes
         if mem:
             rec["memory"] = mem
         return rec
@@ -341,6 +367,42 @@ def main() -> None:
                     {"batch": batch, "remat": remat,
                      "error": str(e).splitlines()[-1][:200]})
                 log("stack4_768 b=%d FAILED: %r" % (batch, e))
+            flush()
+
+    # --- 6. step-compression grid: batch x remat x loss-kernel ------------
+    # (ISSUE 2: the matrix that picks the new default train-step config.
+    # Known-good compile first (b16/none/xla ~ the flagship baseline); the
+    # big-batch remat=none cells are EXPECTED to OOM — that is the datum
+    # that makes remat the batch-32/64 enabler, recorded not skipped.)
+    if want("step_grid"):
+        if on_tpu:
+            grid = [(b, r, k)
+                    for b in (16, 32, 64)
+                    for r in ("none", "stacks", "full")
+                    for k in ("xla", "fused")]
+        else:
+            grid = [(2, "none", "xla"), (2, "stacks", "fused"),
+                    (2, "full", "fused")]
+        for batch, remat, kernel in grid:
+            n = max(8, min(64, 1024 // batch)) if on_tpu else 2
+            try:
+                rec = bench_train(1, batch, n, remat=remat,
+                                  loss_kernel=kernel)
+                results["step_grid"].append(rec)
+                log("step_grid b=%d remat=%s loss=%s: %s"
+                    % (batch, remat, kernel, rec))
+            except Exception as e:  # noqa: BLE001
+                results["step_grid"].append(
+                    {"batch": batch, "remat": remat, "loss_kernel": kernel,
+                     "error": str(e).splitlines()[-1][:200]})
+                log("step_grid b=%d remat=%s loss=%s FAILED: %r"
+                    % (batch, remat, kernel, e))
+            flush()
+        ok = [r for r in results["step_grid"] if "img_per_sec_chip" in r]
+        if ok:
+            results["step_grid_selected"] = max(
+                ok, key=lambda r: r["img_per_sec_chip"])
+            log("step_grid selected: %s" % results["step_grid_selected"])
             flush()
 
     flush()

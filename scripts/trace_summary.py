@@ -36,6 +36,26 @@ def load_events(path: str):
     return data.get("traceEvents", [])
 
 
+def op_durations(events):
+    """RAW-name per-op total durations: {name: [total_us, count]}.
+
+    Unlike `summarize` (which strips XLA uniquifier suffixes for a human
+    top-N), this keeps names exactly as emitted — `fusion.123`,
+    `convolution.1293` — so scripts/roofline.py can join them against the
+    compiled HLO's instruction names. Only duration events (ph == 'X')
+    count; track attribution is dropped (the join is by instruction name,
+    which XLA keeps module-unique)."""
+    out = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = e.get("name", "")
+        rec = out.setdefault(name, [0.0, 0])
+        rec[0] += float(e.get("dur", 0.0))
+        rec[1] += 1
+    return out
+
+
 def summarize(events, top: int):
     # pid/tid -> track name (device streams carry "/device:" or "TPU"/"GPU")
     names = {}

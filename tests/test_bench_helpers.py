@@ -61,9 +61,10 @@ def test_sweep_merge_prior_keeps_only_unrerun_sections():
     sweep = _load_sweep()
     fresh = {"platform": "tpu", "inference_batch_sweep": [],
              "train_batch_sweep": [], "num_stack2": {}, "remat": [],
-             "stack4_768": []}
-    # prior predates the stack4_768 section (an r3-era sweep.json): the
-    # merge must fall back to the fresh empty section, not crash
+             "stack4_768": [], "step_grid": []}
+    # prior predates the stack4_768/step_grid sections (an r3-era
+    # sweep.json): the merge must fall back to the fresh empty section,
+    # not crash
     prior = {"platform": "tpu",
              "inference_batch_sweep": [{"batch": 8, "img_per_sec": 1.0}],
              "train_batch_sweep": [{"batch": 16, "img_per_sec_chip": 2.0}],
@@ -74,6 +75,25 @@ def test_sweep_merge_prior_keeps_only_unrerun_sections():
     assert out["inference_batch_sweep"] == prior["inference_batch_sweep"]
     assert out["num_stack2"] == prior["num_stack2"]
     assert out["stack4_768"] == []
+    assert out["step_grid"] == []
+
+
+def test_sweep_merge_prior_carries_step_grid_selected():
+    sweep = _load_sweep()
+    fresh = {"platform": "tpu", "inference_batch_sweep": [],
+             "train_batch_sweep": [], "num_stack2": {}, "remat": [],
+             "stack4_768": [], "step_grid": []}
+    sel = {"batch": 32, "remat": "stacks", "loss_kernel": "fused"}
+    prior = {"platform": "tpu", "step_grid": [sel],
+             "step_grid_selected": sel}
+    out = sweep.merge_prior(dict(fresh), prior, only={"train"})
+    # the derived pick travels with its (un-rerun) section...
+    assert out["step_grid"] == [sel]
+    assert out["step_grid_selected"] == sel
+    # ...and is dropped when the section is being rerun
+    out2 = sweep.merge_prior(dict(fresh), prior, only={"step_grid"})
+    assert out2["step_grid"] == []
+    assert "step_grid_selected" not in out2
 
 
 def test_sweep_merge_prior_rejects_other_platform():
@@ -173,4 +193,49 @@ def test_sweep_section_keys_cover_all_result_lists():
     sweep = _load_sweep()
     assert set(sweep.SECTION_KEYS.values()) == {
         "inference_batch_sweep", "train_batch_sweep", "num_stack2", "remat",
-        "stack4_768"}
+        "stack4_768", "step_grid"}
+
+
+def test_bytes_of_reports_cost_analysis_bytes():
+    f = jax.jit(lambda a: jnp.sum(a * 2.0))
+    a = jnp.ones((256, 256), jnp.float32)
+    compiled = f.lower(a).compile()
+    by = bench.bytes_of(compiled)
+    # CPU XLA reports 'bytes accessed'; at minimum the input must be read
+    assert by is None or by >= a.size * 4
+
+
+def test_predict_chain_donation_emits_no_warning():
+    """The eval/predict chain donates its image batch and returns the
+    final carry as the aliasing target (ISSUE-2 satellite: it was the one
+    bench program left holding a second input-sized buffer). Lowering +
+    running it must not emit XLA's 'Some donated buffers were not usable'
+    warning, and `chain_timed_fetch` must thread the returned carry so
+    repeats never touch a donated-away buffer."""
+    import warnings
+
+    from jax import lax
+
+    def predict_like(images):  # stand-in for the fused predict program
+        return jnp.tanh(jnp.sum(images))
+
+    def prog(scale, images):
+        def body(imgs, _):
+            eps = (predict_like(imgs) * 1e-12).astype(imgs.dtype)
+            return imgs + eps * scale, ()
+        final, _ = lax.scan(body, images, None, length=2)
+        return final, jnp.sum(final[0, 0])
+
+    chain = jax.jit(prog, donate_argnums=(1,))
+    images = jnp.ones((2, 16, 16, 3), jnp.float32)
+    scale = jnp.float32(1.0)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        compiled = chain.lower(scale, images).compile()
+        images, s = compiled(scale, images)  # donates; carry returned
+        np.asarray(s)
+        dt = bench.chain_timed_fetch(compiled, scale, images, overhead=0.0)
+    assert dt > 0
+    donation_warnings = [w for w in caught
+                         if "donated buffers" in str(w.message)]
+    assert not donation_warnings, [str(w.message) for w in donation_warnings]

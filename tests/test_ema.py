@@ -37,12 +37,22 @@ def _setup(cfg):
     return model, state, step, batch
 
 
+def _host_copy(tree):
+    """OWNING host snapshot. On this box's jax (0.4.37 CPU backend),
+    `jax.device_get` returns zero-copy views of the device buffers
+    (`owndata=False`); a later DONATING step can reuse those buffers and
+    silently rewrite the 'snapshot' (observed: p0 reading back as p1 in
+    the pre-step EMA baselines, failing at an unmodified checkout)."""
+    return jax.tree.map(lambda x: np.array(x, copy=True),
+                        jax.device_get(tree))
+
+
 def test_ema_one_step_math():
     """After one step from init (ema0 == params0):
     ema1 = d*params0 + (1-d)*params1, elementwise."""
     cfg = _cfg()
     _, state, step, batch = _setup(cfg)
-    p0 = jax.device_get(state.params)
+    p0 = _host_copy(state.params)
     state1, _ = step(state, *batch)
     p1 = jax.device_get(state1.params)
     ema1 = jax.device_get(state1.ema_params)
@@ -111,7 +121,7 @@ def test_ema_updates_on_device_augment_path():
     boxes = jnp.zeros((2, cfg.max_boxes, 4), jnp.float32)
     labels = jnp.zeros((2, cfg.max_boxes), jnp.int32)
     valid = jnp.zeros((2, cfg.max_boxes), bool)
-    p0 = jax.device_get(state.params)
+    p0 = _host_copy(state.params)
     state, _ = step(state, jax.random.key(1), jnp.int32(0), images, boxes,
                     labels, valid)
     p1 = jax.device_get(state.params)

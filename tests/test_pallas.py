@@ -60,7 +60,17 @@ def test_fused_peak_pool_size_matches_xla_reference(pool_size):
     logits = jnp.asarray(rng.standard_normal((24, 24, 2)).astype(np.float32) * 3)
     got = fused_peak_scores(logits, interpret=True, pool_size=pool_size)
     want = peak_scores_reference(logits, pool_size=pool_size)
-    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    if pool_size == 1:
+        # pool 1 passes EVERY pixel's sigmoid through (identity peak
+        # test), and on the r7 box's jax the interpret-mode and XLA
+        # compilations of sigmoid differ by 1 ULP on some inputs (an
+        # unmodified checkout fails exact equality here; pool >= 3 only
+        # exposes the few peak values, which agree). On-chip bit-identity
+        # is still asserted by bench.py's pallas_matches_xla.
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-7, atol=0)
+    else:
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 def test_fused_peak_pool_size_changes_peak_set():

@@ -1,0 +1,149 @@
+"""CI guard for scripts/roofline.py (ISSUE-2 satellite): the per-fusion
+attribution tool must keep running end-to-end on the CPU backend and keep
+emitting schema-valid JSON — it is only EXERCISED for real on TPU rounds,
+so without this smoke it would silently rot between them.
+
+One subprocess run on a tiny 2-step trace feeds every assertion (the
+compile dominates; rerunning per-assertion would triple the cost). The
+HLO-parser unit tests below run in-process on a canned module text.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "roofline.py")
+
+
+def _run(out, *extra):
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--platform", "cpu", "--batch", "1",
+         "--imsize", "64", "--steps", "2", "--hourglass-inch", "32",
+         "--out", str(out)] + list(extra),
+        capture_output=True, text=True, timeout=1200, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc
+
+
+@pytest.fixture(scope="module")
+def roofline_run(tmp_path_factory):
+    # smoke tier: ONE traced 2-step run, no --ab-loss-kernel (the A/B
+    # adds four more XLA compiles — slow-tier territory on a cold cache)
+    out = tmp_path_factory.mktemp("roofline") / "roofline_cpu.json"
+    return out, _run(out)
+
+
+def test_roofline_cpu_end_to_end_schema(roofline_run):
+    out, proc = roofline_run
+    assert out.exists()
+    d = json.loads(out.read_text())
+    assert d["schema"] == "roofline-v1"
+    assert d["platform"] == "cpu"
+    for key in ("peak_flops", "hbm_bytes_per_s", "config", "totals",
+                "summary", "fusions"):
+        assert key in d, key
+    assert d["config"]["steps"] == 2
+    assert d["summary"]["ridge_flops_per_byte"] == pytest.approx(
+        d["peak_flops"] / d["hbm_bytes_per_s"], rel=1e-3)
+    rows = d["fusions"]
+    assert len(rows) > 10
+    for r in rows[:50]:
+        for key in ("name", "opcode", "flops", "bytes", "intensity",
+                    "bound", "time_us", "pct_bytes", "t_roofline_us"):
+            assert key in r, (key, r)
+        assert r["bound"] in ("hbm", "mxu")
+        assert r["bytes"] >= 0 and r["flops"] >= 0
+    # the train step must surface its convolutions with real FLOP counts
+    convs = [r for r in rows if r["opcode"] == "convolution"]
+    assert convs and sum(r["flops"] for r in convs) > 0
+    # parsed bytes must reconcile with XLA's own aggregate (same counting
+    # model: operand+result per op) within 2x either way
+    ca = d["totals"]["cost_analysis_bytes"]
+    if ca:
+        ratio = d["totals"]["parsed_bytes"] / ca
+        assert 0.5 < ratio < 2.0, ratio
+    # markdown companion table rides along
+    assert os.path.exists(str(out)[: -len(".json")] + ".md")
+
+
+def test_roofline_trace_times_attributed(roofline_run):
+    out, _ = roofline_run
+    d = json.loads(out.read_text())
+    timed = [r for r in d["fusions"] if r["time_us"] is not None]
+    # the CPU profiler names HLO ops; the join must attribute most rows
+    assert len(timed) > 10
+    assert d["summary"]["total_time_us_per_step"] > 0
+    # pct_time sums to ~100 over timed rows
+    total_pct = sum(r["pct_time"] for r in timed if r["pct_time"])
+    assert 95.0 < total_pct < 105.0
+
+
+@pytest.mark.slow
+def test_roofline_ab_loss_kernel_recorded(tmp_path):
+    out = tmp_path / "roofline_ab.json"
+    _run(out, "--ab-loss-kernel", "--no-trace")
+    d = json.loads(out.read_text())
+    ab = d["loss_kernel_ab"]
+    for key in ("step_xla", "step_fused", "loss_only_xla",
+                "loss_only_fused"):
+        assert key in ab, key
+    # the fused kernel must cut the loss fusion's counted HBM bytes (the
+    # heatmap-sized temporaries it eliminates) by the ISSUE-2 >=15%
+    # target. Off-TPU the fused side is the analytic operand+result count
+    # of the real kernel lowering (the interpret lowering the CPU compiles
+    # is not the kernel — ab["fused_bytes_basis"] records which applied).
+    assert ab["fused_bytes_basis"] in ("parsed", "analytic")
+    assert ab["loss_only_fused"]["kernel_bytes_analytic"] > 0
+    assert ab["loss_bytes_delta_pct"] >= 15.0
+    # and the projection onto the conv-dominated full step is recorded
+    # (the honest denominator for "per train step" claims)
+    assert "step_bytes_delta_pct_projected" in ab
+
+
+def test_hlo_parser_units():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from roofline import attribute, parse_hlo
+    text = """\
+HloModule test, entry_computation_layout={(f32[4,4]{1,0})->f32[4,4]{1,0}}
+
+%fused_computation (p0: f32[4,4]) -> f32[4,4] {
+  %p0 = f32[4,4]{1,0} parameter(0)
+  %log.1 = f32[4,4]{1,0} log(f32[4,4]{1,0} %p0)
+  ROOT %multiply.2 = f32[4,4]{1,0} multiply(f32[4,4]{1,0} %log.1, f32[4,4]{1,0} %p0)
+}
+
+%region_body (arg: (f32[4,4], f32[2,3,3,1])) -> (f32[4,4], f32[2,3,3,1]) {
+  %arg = (f32[4,4]{1,0}, f32[2,3,3,1]{3,2,1,0}) parameter(0)
+  %gte.1 = f32[4,4]{1,0} get-tuple-element((f32[4,4]{1,0}, f32[2,3,3,1]{3,2,1,0}) %arg), index=0
+  ROOT %add.9 = f32[4,4]{1,0} add(f32[4,4]{1,0} %gte.1, f32[4,4]{1,0} %gte.1)
+}
+
+ENTRY %main (Arg_0.1: f32[4,4], Arg_1.2: f32[1,8,8,2], Arg_2.3: f32[3,3,2,4]) -> f32[4,4] {
+  %Arg_0.1 = f32[4,4]{1,0} parameter(0)
+  %Arg_1.2 = f32[1,8,8,2]{3,2,1,0} parameter(1)
+  %Arg_2.3 = f32[3,3,2,4]{3,2,1,0} parameter(2)
+  %convolution.5 = f32[1,8,8,4]{3,2,1,0} convolution(f32[1,8,8,2]{3,2,1,0} %Arg_1.2, f32[3,3,2,4]{3,2,1,0} %Arg_2.3), window={size=3x3 pad=1_1x1_1}, dim_labels=b01f_01io->b01f, metadata={op_name="conv"}
+  ROOT %fusion.7 = f32[4,4]{1,0} fusion(f32[4,4]{1,0} %Arg_0.1), kind=kLoop, calls=%fused_computation
+}
+"""
+    comps, bodies, appliers = parse_hlo(text)
+    assert "fused_computation" in bodies
+    assert set(comps) >= {"fused_computation", "region_body", "main"}
+    # tuple-typed params must not break the computation-boundary parse
+    assert [i.name for i in comps["region_body"]][-1] == "add.9"
+    rows = attribute(comps, bodies, appliers)
+    byname = {r["name"]: r for r in rows}
+    # fusion rolls up its body's elementwise flops (2 ops x 16 elems)
+    assert byname["fusion.7"]["flops"] == 32
+    # fusion bytes = operand + result, body internals excluded
+    assert byname["fusion.7"]["bytes"] == 2 * 16 * 4
+    # conv flops = 2 * out_elems * window * cin = 2 * 256 * 9 * 2
+    assert byname["convolution.5"]["flops"] == 2 * 256 * 9 * 2
+    # fusion-body internals are not reported as rows
+    assert "log.1" not in byname
+    # the while-body add IS reported (its computation is walked)
+    assert "add.9" in byname
