@@ -309,6 +309,45 @@ def chain_timed_fetch(compiled, variables, images, overhead: float,
 
 
 def main() -> None:
+    """Wrapper keeping the ONE-JSON-line contract even on failure: a
+    backend death (or any crash) still prints the line, with
+    `{"error": ..., "error_class": "transient"|"permanent"}` so the
+    supervisor (scripts/tpu_queue.py) and the driver classify without
+    log-scraping (ISSUE 3 satellite). Exit code follows the job
+    contract: 0 done, 75 transient, 1 permanent."""
+    from real_time_helmet_detection_tpu.runtime import (
+        EXIT_TRANSIENT, classify_exception, maybe_job_heartbeat,
+        write_job_status)
+    hb = maybe_job_heartbeat()
+    out: dict = {"metric": None, "value": None, "platform": None}
+
+    def _emit_error(msg: str, klass: str) -> None:
+        out.update({"error": msg[:500], "error_class": klass})
+        print(json.dumps(out))
+        sys.stdout.flush()
+        write_job_status(False, error=msg, error_class=klass)
+
+    try:
+        _bench(out, hb)
+    except KeyboardInterrupt:
+        raise
+    except SystemExit as e:
+        if e.code is None or isinstance(e.code, int):
+            raise  # plain exit (e.g. argparse); not a backend failure
+        # acquire_backend exhausted retries AND the CPU re-exec path:
+        # unreachable backend is transient by definition (retry later)
+        _emit_error(str(e.code), "transient")
+        raise SystemExit(EXIT_TRANSIENT) from e
+    except Exception as e:  # noqa: BLE001 — classified, not swallowed
+        klass = classify_exception(e)
+        head = str(e).splitlines()[0] if str(e) else repr(e)
+        _emit_error("%s: %s" % (type(e).__name__, head), klass)
+        raise SystemExit(EXIT_TRANSIENT if klass == "transient"
+                         else 1) from e
+    write_job_status(True)
+
+
+def _bench(out: dict, hb) -> None:
     jax, devs = acquire_backend()
     import jax.numpy as jnp
     from jax import lax
@@ -317,6 +356,7 @@ def main() -> None:
     device_kind = getattr(devs[0], "device_kind", "unknown")
     on_tpu = platform == "tpu"
     log("backend up: %d x %s (%s)" % (len(devs), device_kind, platform))
+    hb.beat("backend up (%s)" % platform)
 
     peak = DEFAULT_PEAK
     peak_known = False
@@ -345,13 +385,13 @@ def main() -> None:
                  conf_th=0.0, nms_th=0.5, imsize=imsize)
     model = build_model(cfg, dtype=dtype)
     rng = np.random.default_rng(0)
-    out = {
+    out.update({
         "metric": "inference_fps_%d" % imsize, "value": None, "unit": "img/s",
         "vs_baseline": None, "platform": platform,
         "device_kind": device_kind,
         "dtype": "float32" if dtype is None else "bfloat16",
         "imsize": imsize, "batch": batch,
-    }
+    })
 
     if not on_tpu:
         last = find_last_tpu_result()
@@ -414,6 +454,7 @@ def main() -> None:
             % (fps, dt / n_inf * 1e3, batch))
     except Exception as e:  # noqa: BLE001
         log("inference bench failed: %r" % e)
+    hb.beat("inference section done")
 
     # --- batch-1 latency ---------------------------------------------------
     try:
@@ -427,6 +468,7 @@ def main() -> None:
         log("batch-1 device latency: %.3f ms" % (dt / n_b1 * 1e3))
     except Exception as e:  # noqa: BLE001
         log("latency bench failed: %r" % e)
+    hb.beat("latency section done")
 
     # --- train-step throughput + MFU(train) -------------------------------
     try:
@@ -481,6 +523,7 @@ def main() -> None:
             % (train_batch * n_train / dt, dt / n_train * 1e3))
     except Exception as e:  # noqa: BLE001
         log("train bench failed: %r" % e)
+    hb.beat("train section done")
 
     # --- Pallas fused peak kernel vs XLA path (TPU only) ------------------
     # Runs in a TIME-BOUNDED daemon thread: the r4 first on-chip bench hung
@@ -543,14 +586,23 @@ def main() -> None:
         import threading
         th = threading.Thread(target=_pallas_section, daemon=True)
         th.start()
-        th.join(timeout=float(os.environ.get("BENCH_PALLAS_TIMEOUT_S",
-                                             "1200")))
+        deadline = time.time() + float(
+            os.environ.get("BENCH_PALLAS_TIMEOUT_S", "1200"))
+        while th.is_alive() and time.time() < deadline:
+            th.join(timeout=15.0)
+            # keep the job heartbeat alive across the (legitimately slow)
+            # kernel A/B: this section bounds ITSELF — the supervisor's
+            # stale-kill is for hangs nothing else is watching
+            hb.beat("pallas A/B in progress")
         if th.is_alive():
             out["pallas_timeout"] = True
             log("pallas section still running at timeout; reporting "
                 "without it")
             print(json.dumps(out))
             sys.stdout.flush()
+            from real_time_helmet_detection_tpu.runtime import \
+                write_job_status
+            write_job_status(True, extra={"pallas_timeout": True})
             # The hung compile's plugin threads may be non-daemon; force
             # the exit so the JSON line above remains the process result.
             # NOTE exiting mid-remote-compile can wedge the device claim

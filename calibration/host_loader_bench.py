@@ -40,6 +40,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 from bench import find_last_tpu_result, graft_round  # noqa: E402
+from real_time_helmet_detection_tpu.utils import save_json  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DATA = "/tmp/loader_bench_voc"
@@ -113,8 +114,7 @@ def main() -> None:
         make_synthetic_voc(DATA, num_train=args.images, num_test=2,
                            imsize=(args.imsize, args.imsize), max_objects=12,
                            seed=3, style="scenes")
-        with open(meta_path, "w") as f:
-            json.dump(ds_meta, f)
+        save_json(meta_path, ds_meta)
 
     dataset = VOCDataset(DATA, image_set="trainval")
     chip, chip_src = chip_anchor()
@@ -125,9 +125,9 @@ def main() -> None:
                "modes": {}, "ab": {}}
 
     def flush():
+        # atomic: a crash mid-write must not truncate the artifact
         os.makedirs(os.path.dirname(out_path), exist_ok=True)
-        with open(out_path, "w") as f:
-            json.dump(results, f, indent=1)
+        save_json(out_path, results, indent=1)
 
     def make_loader(kind, raw, workers):
         aug = TrainAugmentor(multiscale_flag=False,

@@ -279,12 +279,14 @@ def seed_everything(seed: int) -> None:
 def save_config(cfg: Config, save_path: str) -> None:
     """Persist `argument.txt` + `argument.json` (ref config.py:164-168)."""
     os.makedirs(save_path, exist_ok=True)
+    from .utils import atomic_write_bytes, save_json
     d = dataclasses.asdict(cfg)
-    with open(os.path.join(save_path, "argument.txt"), "w") as f:
-        for key, value in sorted(d.items()):
-            f.write("%s: %s\n" % (key, value))
-    with open(os.path.join(save_path, "argument.json"), "w") as f:
-        json.dump(d, f, indent=2, sort_keys=True)
+    txt = "".join("%s: %s\n" % (key, value) for key, value in
+                  sorted(d.items()))
+    atomic_write_bytes(os.path.join(save_path, "argument.txt"),
+                       txt.encode())
+    save_json(os.path.join(save_path, "argument.json"), d, indent=2,
+              sort_keys=True)
 
 
 def load_config(path: str) -> Config:
@@ -318,6 +320,13 @@ def get_config(argv=None) -> Config:
     if cfg.train_flag:
         os.makedirs(os.path.join(cfg.save_path, "training_log"), exist_ok=True)
     elif cfg.model_load:
+        # a save DIR resolves to its newest complete checkpoint up front,
+        # so the architecture-snapshot lookup below and every downstream
+        # restore agree on the same path (local import: train.py imports
+        # this module at its top)
+        from .train import resolve_model_load
+        cfg = dataclasses.replace(
+            cfg, model_load=resolve_model_load(cfg.model_load))
         snap = os.path.join(os.path.dirname(cfg.model_load), "argument.json")
         if os.path.exists(snap):
             cfg = update_config_for_eval(cfg, load_config(snap))

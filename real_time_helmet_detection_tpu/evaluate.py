@@ -33,7 +33,7 @@ from .data import (CLASS2COLOR, INDEX2CLASS, BatchLoader, DevicePrefetcher,
                    StagedBatch, TestAugmentor, VOCDataset, load_dataset)
 from .models import build_model
 from .predict import make_predict_fn
-from .train import init_variables, restore_variables
+from .train import init_variables, resolve_model_load, restore_variables
 from .utils import (AverageMeter, draw_box, imload, save_pickle, timestamp,
                     write_text)
 
@@ -51,8 +51,11 @@ def load_eval_state(cfg: Config) -> Tuple:
     params, batch_stats = init_variables(model, jax.random.key(cfg.random_seed),
                                          imsize)
     if cfg.model_load:
+        # a save dir resolves to its newest COMPLETE checkpoint (a killed
+        # async save must not poison the pick — see find_latest_checkpoint)
         params, batch_stats = restore_variables(
-            cfg.model_load, params, batch_stats, prefer_ema=cfg.ema_eval)
+            resolve_model_load(cfg.model_load), params, batch_stats,
+            prefer_ema=cfg.ema_eval)
     return model, {"params": params, "batch_stats": batch_stats}
 
 

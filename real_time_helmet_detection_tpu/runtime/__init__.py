@@ -1,0 +1,26 @@
+"""Job-level runtime supervision (ISSUE 3).
+
+Stdlib-only package: importable — and fully CPU-testable — without
+initializing any JAX backend. The in-process robustness layer
+(HangWatchdog, FaultInjector, --auto-resume in train.py) stops at the
+process boundary; this package supervises the *jobs*:
+
+* `errors`     — one transient-vs-permanent classifier for all layers
+* `heartbeat`  — HangWatchdog (in-process) + FileHeartbeat (cross-process)
+* `spool`      — persistent fsynced JSON-lines job journal
+* `supervisor` — relay/claim triage, hang-kill-salvage, backoff requeue
+
+CLI: `scripts/tpu_queue.py` (the required way to run chip jobs —
+see CLAUDE.md and docs/ARCHITECTURE.md "Failure domains & supervision").
+"""
+
+from .errors import (EXIT_TRANSIENT, InjectedBackendError,  # noqa: F401
+                     classify_error_text, classify_exception,
+                     is_transient_backend_error)
+from .heartbeat import (FileHeartbeat, HangWatchdog,  # noqa: F401
+                        heartbeat_age_s, maybe_job_heartbeat,
+                        read_heartbeat, run_as_job, write_job_status)
+from .spool import (CLAIM_WAIT, DONE, FAILED, QUEUED,  # noqa: F401
+                    RUNNING, SALVAGED, JobSpec, JobState, Spool)
+from .supervisor import (CLAIM_WEDGED, HEALTHY, RELAY_DEAD,  # noqa: F401
+                         Supervisor, default_relay_probe)

@@ -1,0 +1,67 @@
+"""Transient-vs-permanent failure classification, shared process-wide.
+
+One definition used by three layers so they cannot drift:
+
+* `train.py --auto-resume` (in-process recovery) classifies the caught
+  exception object;
+* `bench.py` and the other enqueueable scripts classify the exception
+  they are dying with into the machine-readable JSON/status line;
+* the job supervisor (`runtime/supervisor.py`) classifies a dead job's
+  status file / exit code without log-scraping.
+
+Stdlib-only on purpose: the supervisor and `scripts/tpu_queue.py` must be
+importable (and CPU-testable) without initializing any JAX backend.
+"""
+
+from __future__ import annotations
+
+# Status markers that identify a device/transport failure worth retrying
+# (vs a programming error, which must propagate). XLA status-prefix form
+# ("UNAVAILABLE: ...") rather than bare substrings: a genuine programming
+# error whose message merely contains the word "connection" (e.g. a
+# data-loader connection-string bug) must NOT trigger restore-and-retry
+# (round-2 advisor finding). Matched against XlaRuntimeError/RuntimeError.
+TRANSIENT_MARKERS = ("UNAVAILABLE:", "DEADLINE_EXCEEDED:",
+                     "Unable to initialize backend", "Socket closed")
+# INTERNAL is how the axon plugin surfaces tunnel deaths, but it is also
+# XLA's generic assertion bucket — require the XlaRuntimeError type (a
+# plain RuntimeError with "INTERNAL" in its text is not backend evidence).
+TRANSIENT_MARKERS_XLA_ONLY = ("INTERNAL:",)
+
+# Exit-code contract for enqueueable TPU jobs (bench.py, tpu_sweep.py,
+# mfu_breakdown.py, runner_drive.py): 0 = done, EXIT_TRANSIENT = the
+# backend failed in a way a later retry may survive (EX_TEMPFAIL from
+# sysexits.h — conventional "try again"), anything else = permanent.
+EXIT_TRANSIENT = 75
+
+
+class InjectedBackendError(RuntimeError):
+    """Synthetic transient backend failure raised by FaultInjector."""
+
+
+def is_transient_backend_error(e: BaseException) -> bool:
+    """Would retrying after a backend re-init plausibly succeed?"""
+    if isinstance(e, InjectedBackendError):
+        return True
+    if type(e).__name__ not in ("XlaRuntimeError", "RuntimeError"):
+        return False
+    msg = str(e)
+    if any(m in msg for m in TRANSIENT_MARKERS):
+        return True
+    return type(e).__name__ == "XlaRuntimeError" and \
+        any(m in msg for m in TRANSIENT_MARKERS_XLA_ONLY)
+
+
+def classify_exception(e: BaseException) -> str:
+    """'transient' | 'permanent' for status lines and job status files."""
+    return "transient" if is_transient_backend_error(e) else "permanent"
+
+
+def classify_error_text(text: str) -> str:
+    """Best-effort classification when only message TEXT survives (a job
+    log tail, a status file written by an older script). Without the
+    exception type the XLA-only INTERNAL marker cannot be trusted — a
+    plain 'INTERNAL' in prose is not backend evidence — so only the
+    unambiguous status-prefix markers classify as transient."""
+    return ("transient" if any(m in text for m in TRANSIENT_MARKERS)
+            else "permanent")

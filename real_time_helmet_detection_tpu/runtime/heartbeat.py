@@ -1,0 +1,253 @@
+"""Liveness signals: in-process stall warnings + cross-process heartbeats.
+
+Two views of the same contract:
+
+* `HangWatchdog` (moved here from train.py, re-exported there) watches the
+  CURRENT process: warn (with thread stacks) when no progress beat arrives
+  for `warn_seconds`. It cannot unstick a wedged transport, but it turns a
+  silent stall into a diagnosable one.
+* `FileHeartbeat` makes those beats visible to a SUPERVISING process
+  (`runtime/supervisor.py`): every beat atomically rewrites a small JSON
+  file whose mtime is the liveness signal. The supervisor SIGTERMs a job
+  whose file goes stale past the job's deadline and salvages its flushed
+  partial artifacts — the recovery the in-process watchdog cannot perform
+  (it dies with the process; the file survives).
+
+Job-side wiring is env-based so every enqueueable script shares one line:
+`hb = maybe_job_heartbeat()` returns a real FileHeartbeat when
+$TPU_QUEUE_HEARTBEAT names a path (i.e. the job runs under
+scripts/tpu_queue.py) and an inert stub otherwise — unsupervised runs pay
+nothing. `write_job_status` is the matching exit contract: one JSON file
+at $TPU_QUEUE_STATUS the supervisor reads instead of log-scraping.
+
+Stdlib-only: imported by the supervisor/CLI, which must never initialize
+a JAX backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+HEARTBEAT_ENV = "TPU_QUEUE_HEARTBEAT"
+STATUS_ENV = "TPU_QUEUE_STATUS"
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    """tmp + os.replace so a reader (or a crash) never sees a torn file."""
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+class FileHeartbeat:
+    """Per-job heartbeat file: `beat(label)` atomically rewrites
+    `{"t": wall, "pid": ..., "label": ...}`; the file's mtime is what the
+    supervisor watches (content is for the human reading a postmortem)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+
+    def beat(self, label: str = "beat") -> None:
+        try:
+            _atomic_write_text(self.path, json.dumps(
+                {"t": time.time(), "pid": os.getpid(), "label": str(label)}))
+        except OSError:
+            # liveness reporting must never kill the job doing the work
+            pass
+
+
+class _NoopHeartbeat:
+    """Inert stand-in when the process is not running under the queue."""
+
+    path = None
+
+    def beat(self, label: str = "beat") -> None:
+        pass
+
+
+def maybe_job_heartbeat(env: Optional[dict] = None):
+    """FileHeartbeat bound to $TPU_QUEUE_HEARTBEAT, or an inert stub."""
+    path = (env if env is not None else os.environ).get(HEARTBEAT_ENV)
+    return FileHeartbeat(path) if path else _NoopHeartbeat()
+
+
+def read_heartbeat(path: str) -> Optional[dict]:
+    """Last beat record, or None (absent / torn / not yet beaten)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def heartbeat_age_s(path: str, now: Optional[float] = None) -> Optional[float]:
+    """Seconds since the file was last touched; None when it never was.
+    mtime-based: robust even if the writer died mid-beat."""
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        return None
+    return max(0.0, (time.time() if now is None else now) - mtime)
+
+
+def write_job_status(ok: bool, error: str = "", error_class: str = "",
+                     extra: Optional[dict] = None,
+                     env: Optional[dict] = None) -> None:
+    """Machine-readable exit status at $TPU_QUEUE_STATUS (no-op when the
+    job is unsupervised). The supervisor prefers this file over exit-code
+    guessing; `error_class` follows runtime.errors ('transient' or
+    'permanent')."""
+    path = (env if env is not None else os.environ).get(STATUS_ENV)
+    if not path:
+        return
+    rec = {"ok": bool(ok), "t": time.time(), "pid": os.getpid()}
+    if error:
+        rec["error"] = str(error)[:500]
+    if error_class:
+        rec["error_class"] = error_class
+    if extra:
+        rec.update(extra)
+    try:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        _atomic_write_text(path, json.dumps(rec))
+    except OSError:
+        pass
+
+
+def run_as_job(main_fn) -> None:
+    """Exit shim for enqueueable scripts (tpu_sweep, mfu_breakdown,
+    runner_drive): run `main_fn`, write the machine-readable
+    $TPU_QUEUE_STATUS file, and map failures onto the exit-code contract
+    (0 done / EXIT_TRANSIENT transient / 1 permanent). bench.py has its
+    own wrapper because it must additionally keep its ONE-JSON-line
+    promise on the error path."""
+    from .errors import EXIT_TRANSIENT, classify_exception
+    try:
+        main_fn()
+    except KeyboardInterrupt:
+        raise
+    except SystemExit as e:
+        if e.code in (None, 0):
+            write_job_status(True)
+            raise
+        if isinstance(e.code, int):
+            write_job_status(False, error="exit code %d" % e.code,
+                             error_class="permanent")
+            raise
+        # string SystemExits here are acquire_backend's "backend
+        # unavailable" family: unreachable hardware is transient —
+        # retrying after the relay/claim recovers may well succeed
+        write_job_status(False, error=str(e.code), error_class="transient")
+        raise SystemExit(EXIT_TRANSIENT) from e
+    except Exception as e:  # noqa: BLE001 — classified, not swallowed
+        klass = classify_exception(e)
+        head = str(e).splitlines()[0] if str(e) else repr(e)
+        write_job_status(False, error="%s: %s" % (type(e).__name__, head),
+                         error_class=klass)
+        raise SystemExit(EXIT_TRANSIENT if klass == "transient"
+                         else 1) from e
+    else:
+        write_job_status(True)
+
+
+class HangWatchdog:
+    """Background failure detector: warns (with thread stacks) when no
+    progress beat arrives for `warn_seconds`.
+
+    The reference has no failure detection (SURVEY.md §5); this exists
+    because remote accelerator transports can wedge mid-run with the
+    process stuck in an uninterruptible wait — the watchdog cannot unstick
+    it, but it turns a silent stall into a diagnosable one (and tells the
+    operator the last good step, so they know which checkpoint to salvage).
+
+    `beat_file` (new): mirror every beat into a FileHeartbeat so a job
+    supervisor can watch this process from outside. Pause/resume beat the
+    file too — a legitimate slow phase (checkpoint save) must read as
+    alive to the supervisor exactly as it reads as non-stalled in here.
+    """
+
+    def __init__(self, warn_seconds: float, where: str = "train",
+                 beat_file: Optional[str] = None):
+        import threading
+        self.warn_seconds = float(warn_seconds)
+        self.where = where
+        self._beat = time.monotonic()  # immune to wall-clock NTP steps
+        self._label = "start"
+        self._stop = threading.Event()
+        self._warned = False
+        self._paused = False
+        self._thread = None
+        self._status_fn = None
+        self._file = FileHeartbeat(beat_file) if beat_file else None
+        if self._file is not None:
+            self._file.beat("start")
+        if self.warn_seconds > 0:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def set_status_fn(self, fn) -> None:
+        """Attach a () -> str status provider whose output is appended to
+        every warning — e.g. the process loader's per-worker heartbeat
+        ages (`ProcessBatchLoader.worker_status`), so a stall can be
+        attributed to the input pipeline vs the device transport at a
+        glance."""
+        self._status_fn = fn
+
+    def beat(self, label: str) -> None:
+        self._beat = time.monotonic()
+        self._label = label
+        self._warned = False
+        if self._file is not None:
+            self._file.beat(label)
+
+    def pause(self, label: str) -> None:
+        """Suspend warnings across a known-slow operation (checkpoint save:
+        a full-state device_get can legitimately take minutes on a slow
+        transport). A point beat only resets the clock; pause holds it."""
+        self._paused = True
+        self._label = label
+        if self._file is not None:
+            self._file.beat("paused: %s" % label)
+
+    def resume(self, label: str) -> None:
+        self._paused = False
+        self.beat(label)
+
+    def _run(self) -> None:
+        import faulthandler
+        import sys
+        while not self._stop.wait(min(30.0, self.warn_seconds / 4)):
+            stalled = time.monotonic() - self._beat
+            if self._paused and self._file is not None:
+                # a paused watchdog is a process that DECLARED itself busy,
+                # not a dead one: keep the external heartbeat alive so the
+                # supervisor's stale-kill deadline only fires on real hangs
+                self._file.beat("paused: %s" % self._label)
+            if stalled > self.warn_seconds and not self._warned \
+                    and not self._paused:
+                self._warned = True
+                extra = ""
+                if self._status_fn is not None:
+                    try:
+                        extra = " | " + str(self._status_fn())
+                    except Exception:  # noqa: BLE001 — status is best-effort
+                        pass
+                print("%s: WATCHDOG: no %s progress for %.0fs (last: %s) — "
+                      "the device transport may be wedged; if this "
+                      "persists, kill and resume from the last checkpoint%s"
+                      % (time.ctime(), self.where, stalled, self._label,
+                         extra),
+                      flush=True)
+                try:  # where is the main thread stuck? (needs a real fd —
+                    faulthandler.dump_traceback(file=sys.__stderr__)
+                except Exception:  # absent under captured/redirected stderr
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()

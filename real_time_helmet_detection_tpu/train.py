@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
 from functools import partial
 from typing import Any, Dict, Optional
@@ -46,7 +47,13 @@ from .ops.loss import (LossLog, split_stack_predictions,
 from .optim import build_optimizer
 from .parallel import (batch_sharding, init_distributed, make_mesh,
                        replicated, shard_batch)
-from .utils import AverageMeter, blend_heatmap, timestamp
+# HangWatchdog and the transient-error classifier live in runtime/ (the
+# job supervisor shares them); re-exported here so existing imports
+# (`from ...train import HangWatchdog`) keep working.
+from .runtime.errors import (InjectedBackendError,  # noqa: F401
+                             is_transient_backend_error)
+from .runtime.heartbeat import HEARTBEAT_ENV, HangWatchdog  # noqa: F401
+from .utils import AverageMeter, blend_heatmap, save_json, timestamp
 
 
 class TrainState(struct.PyTreeNode):
@@ -350,8 +357,9 @@ def _checkpoint_path(save_path: str, epoch: int) -> str:
 
 
 def _write_loss_log(path: str, log_state: dict) -> None:
-    with open(os.path.join(path, "loss_log.json"), "w") as f:
-        json.dump(log_state, f)
+    # atomic: a kill mid-write must leave either no sidecar (handled by
+    # _read_loss_log) or a complete one — never a truncated JSON
+    save_json(os.path.join(path, "loss_log.json"), log_state)
 
 
 def _checkpoint_item(epoch: int, state: TrainState) -> dict:
@@ -431,6 +439,70 @@ class CheckpointWriter:
         if self._ckpt is not None:
             self._ckpt.wait_until_finished()
             self._write_sidecars()
+
+
+_CKPT_RE = re.compile(r"^check_point_(\d+)$")
+# orbax finalizes a save by writing the checkpoint metadata after the
+# atomic tmp-dir rename; a dir missing these markers (or still carrying
+# the ".orbax-checkpoint-tmp" name, excluded by the regex above) is a
+# save that was killed mid-flight (--async-ckpt) and must never be picked
+_CKPT_COMMIT_MARKERS = ("_CHECKPOINT_METADATA", "commit_success.txt")
+
+
+def checkpoint_complete(path: str) -> bool:
+    """Is this directory a FINALIZED checkpoint (safe to restore)?"""
+    if not os.path.isdir(path):
+        return False
+    try:
+        entries = set(os.listdir(path))
+    except OSError:
+        return False
+    return any(m in entries for m in _CKPT_COMMIT_MARKERS)
+
+
+def find_latest_checkpoint(save_path: str) -> Optional[str]:
+    """Newest COMPLETE `check_point_N` under `save_path`, or None.
+
+    Skips incomplete/corrupt dirs: an async save killed mid-write leaves
+    either an orbax tmp-named dir (name excluded) or a dir without the
+    commit marker (content excluded) — neither may poison the
+    newest-checkpoint pick that a resume or the runner-drive export
+    makes (ISSUE 3 satellite)."""
+    try:
+        entries = os.listdir(save_path)
+    except OSError:
+        return None
+    numbered = []
+    for name in entries:
+        m = _CKPT_RE.match(name)
+        if m:
+            numbered.append((int(m.group(1)), name))
+    for _, name in sorted(numbered, reverse=True):
+        path = os.path.join(save_path, name)
+        if checkpoint_complete(path):
+            return path
+        print("%s: skipping incomplete/corrupt checkpoint %s"
+              % (timestamp(), path), flush=True)
+    return None
+
+
+def resolve_model_load(path: str) -> str:
+    """Accept either a checkpoint dir or a SAVE dir in --model-load: a
+    save dir (contains check_point_N children, is not itself one)
+    resolves to its newest complete checkpoint. Unresolvable inputs are
+    returned unchanged so the restore's own error names the real path."""
+    if not path or not os.path.isdir(path):
+        return path
+    if _CKPT_RE.match(os.path.basename(os.path.normpath(path))) \
+            or checkpoint_complete(path):
+        return path
+    latest = find_latest_checkpoint(path)
+    if latest:
+        print("%s: --model-load %s is a save dir; using its newest "
+              "complete checkpoint %s" % (timestamp(), path, latest),
+              flush=True)
+        return latest
+    return path
 
 
 def _restore_raw(path: str) -> dict:
@@ -707,89 +779,6 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
     return runner
 
 
-class HangWatchdog:
-    """Background failure detector: warns (with thread stacks) when no
-    progress beat arrives for `warn_seconds`.
-
-    The reference has no failure detection (SURVEY.md §5); this exists
-    because remote accelerator transports can wedge mid-run with the
-    process stuck in an uninterruptible wait — the watchdog cannot unstick
-    it, but it turns a silent stall into a diagnosable one (and tells the
-    operator the last good step, so they know which checkpoint to salvage).
-    """
-
-    def __init__(self, warn_seconds: float, where: str = "train"):
-        import threading
-        self.warn_seconds = float(warn_seconds)
-        self.where = where
-        self._beat = time.monotonic()  # immune to wall-clock NTP steps
-        self._label = "start"
-        self._stop = threading.Event()
-        self._warned = False
-        self._paused = False
-        self._thread = None
-        self._status_fn = None
-        if self.warn_seconds > 0:
-            self._thread = threading.Thread(target=self._run, daemon=True)
-            self._thread.start()
-
-    def set_status_fn(self, fn) -> None:
-        """Attach a () -> str status provider whose output is appended to
-        every warning — e.g. the process loader's per-worker heartbeat
-        ages (`ProcessBatchLoader.worker_status`), so a stall can be
-        attributed to the input pipeline vs the device transport at a
-        glance."""
-        self._status_fn = fn
-
-    def beat(self, label: str) -> None:
-        self._beat = time.monotonic()
-        self._label = label
-        self._warned = False
-
-    def pause(self, label: str) -> None:
-        """Suspend warnings across a known-slow operation (checkpoint save:
-        a full-state device_get can legitimately take minutes on a slow
-        transport). A point beat only resets the clock; pause holds it."""
-        self._paused = True
-        self._label = label
-
-    def resume(self, label: str) -> None:
-        self._paused = False
-        self.beat(label)
-
-    def _run(self) -> None:
-        import faulthandler
-        import sys
-        while not self._stop.wait(min(30.0, self.warn_seconds / 4)):
-            stalled = time.monotonic() - self._beat
-            if stalled > self.warn_seconds and not self._warned \
-                    and not self._paused:
-                self._warned = True
-                extra = ""
-                if self._status_fn is not None:
-                    try:
-                        extra = " | " + str(self._status_fn())
-                    except Exception:  # noqa: BLE001 — status is best-effort
-                        pass
-                print("%s: WATCHDOG: no %s progress for %.0fs (last: %s) — "
-                      "the device transport may be wedged; if this "
-                      "persists, kill and resume from the last checkpoint%s"
-                      % (timestamp(), self.where, stalled, self._label,
-                         extra),
-                      flush=True)
-                try:  # where is the main thread stuck? (needs a real fd —
-                    faulthandler.dump_traceback(file=sys.__stderr__)
-                except Exception:  # absent under captured/redirected stderr
-                    pass
-
-    def stop(self) -> None:
-        self._stop.set()
-
-
-class InjectedBackendError(RuntimeError):
-    """Synthetic transient backend failure raised by FaultInjector."""
-
-
 class FaultInjector:
     """Debug fault injection: raise ONE synthetic transient backend error
     at a given "EPOCH:ITER" (--fault-inject). The reference has no fault
@@ -814,33 +803,6 @@ class FaultInjector:
             raise InjectedBackendError(
                 "injected backend fault at epoch %d iter %d (UNAVAILABLE)"
                 % (epoch, i))
-
-
-# Status markers that identify a device/transport failure worth retrying
-# (vs a programming error, which must propagate). XLA status-prefix form
-# ("UNAVAILABLE: ...") rather than bare substrings: a genuine programming
-# error whose message merely contains the word "connection" (e.g. a
-# data-loader connection-string bug) must NOT trigger restore-and-retry
-# (round-2 advisor finding). Matched against XlaRuntimeError/RuntimeError.
-_TRANSIENT_MARKERS = ("UNAVAILABLE:", "DEADLINE_EXCEEDED:",
-                      "Unable to initialize backend", "Socket closed")
-# INTERNAL is how the axon plugin surfaces tunnel deaths, but it is also
-# XLA's generic assertion bucket — require the XlaRuntimeError type (a
-# plain RuntimeError with "INTERNAL" in its text is not backend evidence).
-_TRANSIENT_MARKERS_XLA_ONLY = ("INTERNAL:",)
-
-
-def is_transient_backend_error(e: BaseException) -> bool:
-    """Would retrying after a backend re-init plausibly succeed?"""
-    if isinstance(e, InjectedBackendError):
-        return True
-    if type(e).__name__ not in ("XlaRuntimeError", "RuntimeError"):
-        return False
-    msg = str(e)
-    if any(m in msg for m in _TRANSIENT_MARKERS):
-        return True
-    return type(e).__name__ == "XlaRuntimeError" and \
-        any(m in msg for m in _TRANSIENT_MARKERS_XLA_ONLY)
 
 
 def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
@@ -1014,7 +976,8 @@ def train(cfg: Config) -> TrainState:
     loss_log = LossLog()
     start_epoch = cfg.start_epoch
     if cfg.model_load:
-        state, ckpt_epoch, loss_log = load_checkpoint(cfg.model_load, state)
+        state, ckpt_epoch, loss_log = load_checkpoint(
+            resolve_model_load(cfg.model_load), state)
         start_epoch = cfg.start_epoch or (ckpt_epoch + 1)
         if is_chief:
             print("%s: resumed from %s (epoch %d)"
@@ -1065,7 +1028,11 @@ def train(cfg: Config) -> TrainState:
         # still be in flight (or half-written) at the moment of failure
         raise ValueError("--auto-resume requires synchronous checkpoints "
                          "(drop --async-ckpt)")
-    watchdog = HangWatchdog(cfg.hang_warn_seconds)
+    # When running under scripts/tpu_queue.py the supervisor exports a
+    # heartbeat path: the watchdog's beats double as the job's liveness
+    # signal, so a wedged step trips the supervisor's kill-salvage too.
+    watchdog = HangWatchdog(cfg.hang_warn_seconds,
+                            beat_file=os.environ.get(HEARTBEAT_ENV))
     if hasattr(loader, "worker_status"):
         # the watchdog's stall warning names each loader worker's liveness
         # and heartbeat age, so an input-pipeline stall is attributable

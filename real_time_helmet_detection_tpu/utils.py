@@ -22,9 +22,34 @@ import numpy as np
 from PIL import Image, ImageDraw, ImageFont
 
 
+def atomic_write_bytes(path, data: bytes) -> None:
+    """tmp + os.replace: a crash (or a supervisor SIGKILL) mid-write must
+    never leave a truncated artifact where a complete one stood — the
+    salvage path (runtime/supervisor.py) trusts every file it finds.
+    os.replace is atomic on POSIX within one filesystem; the tmp file
+    sits next to the target to stay on it."""
+    import os
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def save_json(path, obj, **dump_kw) -> None:
+    """Atomic (tmp + rename) JSON artifact write; see atomic_write_bytes."""
+    atomic_write_bytes(path, json.dumps(obj, **dump_kw).encode())
+
+
 def save_pickle(path, data):
-    with open(path, "wb") as f:
-        pickle.dump(data, f, protocol=pickle.HIGHEST_PROTOCOL)
+    atomic_write_bytes(
+        path, pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL))
 
 
 def load_pickle(path):

@@ -259,8 +259,8 @@ def main() -> None:
                     "collectives only, NOT hardware scaling; efficiency is "
                     "computed within each hardware class separately"),
            "results": results}
-    with open(args.out, "w") as f:
-        json.dump(out, f, indent=2)
+    from real_time_helmet_detection_tpu.utils import save_json
+    save_json(args.out, out, indent=2)  # atomic: crash-safe artifact
     print(json.dumps(out))
 
 

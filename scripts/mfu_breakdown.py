@@ -55,6 +55,9 @@ from bench import (DEFAULT_HBM, DEFAULT_PEAK, HBM_GBPS, PEAK_BF16,
                    acquire_backend, bytes_of, find_last_tpu_result,
                    flops_of, graft_round, log, measure_dispatch_overhead,
                    timed_fetch)
+from real_time_helmet_detection_tpu.runtime import (maybe_job_heartbeat,
+                                                    run_as_job)
+from real_time_helmet_detection_tpu.utils import save_json
 
 ANALYTIC = "--analytic" in sys.argv
 
@@ -146,10 +149,14 @@ def main() -> None:
                     "choices — a proxy for the TPU compiler's, provisional "
                     "until the on-chip mfu_breakdown.json lands"})
 
+    hb = maybe_job_heartbeat()
+
     def flush():
+        # atomic (tmp + rename) per-component flush doubles as the job
+        # heartbeat — see tpu_sweep.py's flush for the rationale
         os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
-        with open(OUT_PATH, "w") as f:
-            json.dump(results, f, indent=1)
+        save_json(OUT_PATH, results, indent=1)
+        hb.beat("flushed %s" % os.path.basename(OUT_PATH))
 
     def chained(step_fn, x0, n_iter, extra_args=()):
         """Scan `step_fn` n_iter times with a data dependency through x0.
@@ -460,4 +467,4 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    run_as_job(main)  # status file + 0/75/1 exit contract (runtime/)

@@ -43,6 +43,9 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import graft_round  # noqa: E402 — one shared round default
+from real_time_helmet_detection_tpu.runtime import \
+    maybe_job_heartbeat  # noqa: E402
+from real_time_helmet_detection_tpu.utils import save_json  # noqa: E402
 
 OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "artifacts",
@@ -111,8 +114,7 @@ def main() -> None:
         make_synthetic_voc(DATA_ROOT, num_train=n_train, num_test=n_test,
                            imsize=(imsize, imsize), max_objects=12, seed=42,
                            style="scenes")
-        with open(meta_path, "w") as f:
-            json.dump(ds_meta, f)
+        save_json(meta_path, ds_meta)
 
     results = {"fixture": "scenes", "imsize": imsize, "n_train": n_train,
                "n_test": n_test, "epochs": epochs, "rows": {}}
@@ -126,10 +128,13 @@ def main() -> None:
         except (json.JSONDecodeError, OSError):
             pass
 
+    hb = maybe_job_heartbeat()
+
     def flush():
+        # atomic per-row flush doubles as the job heartbeat (runtime/)
         os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
-        with open(OUT_PATH, "w") as f:
-            json.dump(results, f, indent=1)
+        save_json(OUT_PATH, results, indent=1)
+        hb.beat("flushed %s" % os.path.basename(OUT_PATH))
 
     def want(row):
         return (only is None or row in only) and row not in results["rows"]

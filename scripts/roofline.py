@@ -562,12 +562,12 @@ def main() -> None:
         out_path = os.path.join(root, "artifacts", graft_round(),
                                 "roofline",
                                 "roofline_%s%s.json" % (platform, tag))
+    from real_time_helmet_detection_tpu.utils import (atomic_write_bytes,
+                                                      save_json)
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    with open(out_path, "w") as f:
-        json.dump(meta, f, indent=1)
+    save_json(out_path, meta, indent=1)  # atomic: crash-safe artifact
     md_path = out_path.rsplit(".", 1)[0] + ".md"
-    with open(md_path, "w") as f:
-        f.write(_markdown(rows, meta, args.top))
+    atomic_write_bytes(md_path, _markdown(rows, meta, args.top).encode())
     log("wrote %s (+ %s)" % (out_path, os.path.basename(md_path)))
     # one JSON line on stdout (repo convention), without the full table
     print(json.dumps({k: v for k, v in meta.items() if k != "fusions"}

@@ -38,6 +38,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from bench import graft_round  # noqa: E402 — one shared round default
+from real_time_helmet_detection_tpu.runtime import (  # noqa: E402
+    maybe_job_heartbeat, run_as_job)
+from real_time_helmet_detection_tpu.utils import save_json  # noqa: E402
+
+HB = maybe_job_heartbeat()
 
 ROUND = graft_round()
 OUT_PATH = os.path.join(REPO, "artifacts", ROUND, "runner_fps.json")
@@ -56,22 +61,22 @@ def log(msg: str) -> None:
 
 
 def flush(results: dict) -> None:
+    # atomic incremental flush doubles as the job heartbeat (runtime/)
     os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
-    with open(OUT_PATH, "w") as f:
-        json.dump(results, f, indent=1)
+    save_json(OUT_PATH, results, indent=1)
+    HB.beat("flushed %s" % os.path.basename(OUT_PATH))
 
 
 def find_trained_ckpt() -> str | None:
     """Latest quality_matrix base checkpoint, only if its training RAN TO
-    COMPLETION (TRAIN_DONE marker — a wedged run leaves a partial dir)."""
+    COMPLETION (TRAIN_DONE marker — a wedged run leaves a partial dir).
+    The pick itself validates orbax finalization (train.py
+    find_latest_checkpoint): a kill mid-save must not hand the export a
+    truncated checkpoint."""
     if not os.path.exists(os.path.join(QMATRIX_BASE, "TRAIN_DONE")):
         return None
-    cks = [d for d in os.listdir(QMATRIX_BASE)
-           if d.startswith("check_point_")]
-    if not cks:
-        return None
-    return os.path.join(QMATRIX_BASE, max(
-        cks, key=lambda d: int(d.rsplit("_", 1)[1])))
+    from real_time_helmet_detection_tpu.train import find_latest_checkpoint
+    return find_latest_checkpoint(QMATRIX_BASE)
 
 
 def render_image(path: str) -> "tuple":
@@ -196,8 +201,24 @@ def main() -> None:
         log("running depth=%d: %s" % (depth, " ".join(cmd[:6]) + " ..."))
         t0 = time.time()
         try:
-            r = subprocess.run(cmd, capture_output=True, text=True,
-                               timeout=1800)
+            # Popen + beating wait instead of a blind subprocess.run: the
+            # C++ runner legitimately takes minutes (remote compile), and
+            # a silent 1800 s wait would read as a hang to the supervisor
+            # — whose SIGTERM would orphan a TPU-claiming child (the
+            # wedge hazard this script exists to avoid).
+            proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True)
+            deadline = time.time() + 1800
+            while proc.poll() is None and time.time() < deadline:
+                HB.beat("runner depth=%d running" % depth)
+                time.sleep(10)
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+                raise subprocess.TimeoutExpired(cmd, 1800)
+            r_stdout, r_stderr = proc.communicate()
+            r = subprocess.CompletedProcess(cmd, proc.returncode,
+                                            r_stdout, r_stderr)
         except subprocess.TimeoutExpired:
             # A timeout here killed a TPU-claiming process — the claim may
             # now be wedged (CLAUDE.md). Launching the next depth would
@@ -243,4 +264,4 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    run_as_job(main)  # status file + 0/75/1 exit contract (runtime/)

@@ -41,6 +41,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import (DEFAULT_PEAK, PEAK_BF16, acquire_backend,
                    chain_timed_fetch, flops_of, graft_round, log,
                    measure_dispatch_overhead, timed_fetch)
+from real_time_helmet_detection_tpu.runtime import (maybe_job_heartbeat,
+                                                    run_as_job)
+from real_time_helmet_detection_tpu.utils import save_json
 
 
 def memory_analysis_of(compiled):
@@ -195,10 +198,16 @@ def main() -> None:
     if prior is not None and only:
         results = merge_prior(results, prior, only)
 
+    hb = maybe_job_heartbeat()
+
     def flush():
+        # tmp + os.replace: the documented truncation hazard — a kill
+        # (or the supervisor's stale-heartbeat SIGTERM) mid-flush must
+        # never destroy the per-config partials the salvage step records.
+        # Each flush is also the job's natural heartbeat.
         os.makedirs(os.path.dirname(out_path), exist_ok=True)
-        with open(out_path, "w") as f:
-            json.dump(results, f, indent=1)
+        save_json(out_path, results, indent=1)
+        hb.beat("flushed %s" % os.path.basename(out_path))
 
     def want(section):
         return only is None or section in only
@@ -410,4 +419,4 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    run_as_job(main)  # status file + 0/75/1 exit contract (runtime/)

@@ -239,3 +239,65 @@ def test_predict_chain_donation_emits_no_warning():
     donation_warnings = [w for w in caught
                          if "donated buffers" in str(w.message)]
     assert not donation_warnings, [str(w.message) for w in donation_warnings]
+
+
+def test_bench_error_path_still_prints_one_json_line(monkeypatch, capsys):
+    """ISSUE 3 satellite: a backend failure must yield THE one JSON line
+    (with error + error_class) and the transient exit code — never a raw
+    traceback the driver/supervisor has to log-scrape."""
+    import json
+
+    import pytest
+
+    def boom(out, hb):
+        out["platform"] = "tpu"  # partial results ride along
+        raise RuntimeError("UNAVAILABLE: TPU backend setup/compile error")
+
+    monkeypatch.setattr(bench, "_bench", boom)
+    with pytest.raises(SystemExit) as ei:
+        bench.main()
+    assert ei.value.code == 75  # EXIT_TRANSIENT
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["error_class"] == "transient"
+    assert "UNAVAILABLE" in rec["error"]
+    assert rec["platform"] == "tpu"  # the partial field survived
+
+
+def test_bench_error_path_permanent_classification(monkeypatch, capsys):
+    import json
+
+    import pytest
+
+    def boom(out, hb):
+        raise ValueError("shape mismatch in user code")
+
+    monkeypatch.setattr(bench, "_bench", boom)
+    with pytest.raises(SystemExit) as ei:
+        bench.main()
+    assert ei.value.code == 1
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["error_class"] == "permanent"
+    assert rec["value"] is None
+
+
+def test_save_json_and_pickle_are_atomic(tmp_path):
+    """tmp + os.replace: the write leaves either the OLD complete file or
+    the NEW complete file, and no tmp residue (ISSUE 3 satellite)."""
+    import json
+
+    from real_time_helmet_detection_tpu.utils import (load_pickle,
+                                                      save_json,
+                                                      save_pickle)
+
+    jpath = str(tmp_path / "artifact.json")
+    save_json(jpath, {"a": 1}, indent=1)
+    save_json(jpath, {"a": 2}, indent=1)  # overwrite goes through replace
+    with open(jpath) as f:
+        assert json.load(f) == {"a": 2}
+
+    ppath = str(tmp_path / "artifact.pickle")
+    save_pickle(ppath, {"b": [1, 2, 3]})
+    assert load_pickle(ppath) == {"b": [1, 2, 3]}
+
+    leftovers = [n for n in os.listdir(str(tmp_path)) if ".tmp." in n]
+    assert leftovers == []

@@ -1,0 +1,91 @@
+"""Newest-checkpoint pick must skip incomplete/corrupt checkpoint dirs.
+
+The hazard (ISSUE 3 satellite): --async-ckpt hands orbax the save and
+returns; a kill mid-save leaves either an orbax tmp-named dir or a
+check_point_N dir without the finalization marker. A resume (or
+runner_drive's export) that blindly picks max(N) would then crash — or
+worse, restore garbage. `find_latest_checkpoint` must fall back to the
+newest COMPLETE checkpoint instead.
+"""
+
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from real_time_helmet_detection_tpu.ops.loss import LossLog
+from real_time_helmet_detection_tpu.train import (TrainState,
+                                                  checkpoint_complete,
+                                                  find_latest_checkpoint,
+                                                  load_checkpoint,
+                                                  resolve_model_load,
+                                                  save_checkpoint)
+
+
+def _tiny_state(val=0.0):
+    return TrainState(step=jnp.zeros((), jnp.int32),
+                      params={"w": jnp.full((2,), val)},
+                      batch_stats={},
+                      opt_state={"m": jnp.zeros((2,))})
+
+
+@pytest.fixture()
+def save_dir(tmp_path):
+    """check_point_1 and check_point_2 complete; 3 is a killed mid-save
+    (dir exists, no finalization marker); plus an orbax tmp dir and a
+    stray non-checkpoint dir."""
+    root = str(tmp_path / "w")
+    save_checkpoint(root, 0, _tiny_state(1.0), LossLog())   # check_point_1
+    save_checkpoint(root, 1, _tiny_state(2.0), LossLog())   # check_point_2
+    # killed async save, variant A: orbax tmp name never renamed
+    os.makedirs(os.path.join(
+        root, "check_point_3.orbax-checkpoint-tmp-1700000000"))
+    # killed async save, variant B: renamed dir but no commit marker
+    incomplete = os.path.join(root, "check_point_3")
+    os.makedirs(incomplete)
+    with open(os.path.join(incomplete, "manifest.ocdbt"), "w") as f:
+        f.write("")  # partial content, not finalized
+    os.makedirs(os.path.join(root, "training_log"))  # unrelated dir
+    return root
+
+
+def test_checkpoint_complete_detects_finalization(save_dir):
+    assert checkpoint_complete(os.path.join(save_dir, "check_point_2"))
+    assert not checkpoint_complete(os.path.join(save_dir, "check_point_3"))
+    assert not checkpoint_complete(os.path.join(save_dir, "nonexistent"))
+
+
+def test_pick_skips_incomplete_newest(save_dir, capsys):
+    picked = find_latest_checkpoint(save_dir)
+    assert picked == os.path.join(save_dir, "check_point_2")
+    assert "skipping incomplete/corrupt checkpoint" \
+        in capsys.readouterr().out
+
+
+def test_picked_checkpoint_actually_restores(save_dir):
+    picked = find_latest_checkpoint(save_dir)
+    state, epoch, _ = load_checkpoint(picked, _tiny_state())
+    assert epoch == 1
+    assert float(state.params["w"][0]) == 2.0
+
+
+def test_pick_none_when_nothing_complete(tmp_path):
+    root = str(tmp_path / "w")
+    os.makedirs(os.path.join(root, "check_point_1"))  # empty = incomplete
+    assert find_latest_checkpoint(root) is None
+    assert find_latest_checkpoint(str(tmp_path / "missing")) is None
+
+
+def test_resolve_model_load_redirects_save_dir(save_dir):
+    # a SAVE dir resolves to its newest complete checkpoint...
+    assert resolve_model_load(save_dir) == os.path.join(save_dir,
+                                                        "check_point_2")
+    # ...a direct checkpoint path passes through untouched, even the
+    # incomplete one (explicit user choice: let the restore error name it)
+    direct = os.path.join(save_dir, "check_point_1")
+    assert resolve_model_load(direct) == direct
+    direct3 = os.path.join(save_dir, "check_point_3")
+    assert resolve_model_load(direct3) == direct3
+    # non-paths pass through for the caller's own error message
+    assert resolve_model_load("") == ""
+    assert resolve_model_load("/nonexistent/x") == "/nonexistent/x"

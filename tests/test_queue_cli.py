@@ -1,0 +1,183 @@
+"""scripts/tpu_queue.py CLI + the job-side heartbeat/status contract.
+
+The selfcheck test is the one place the WHOLE stack runs with real
+subprocesses (spawn, SIGTERM, heartbeat files, journal replay) — on CPU,
+with healthy probes injected, in the smoke tier. A hard SIGALRM bounds
+every test: nothing here may ever block on a real `jax.devices()`.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from real_time_helmet_detection_tpu.runtime import (EXIT_TRANSIENT,
+                                                    FileHeartbeat,
+                                                    classify_error_text,
+                                                    classify_exception,
+                                                    heartbeat_age_s,
+                                                    maybe_job_heartbeat,
+                                                    read_heartbeat,
+                                                    run_as_job,
+                                                    write_job_status)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout():
+    def _fire(signum, frame):
+        raise RuntimeError("test exceeded the hard timeout — something "
+                           "blocked (a real probe/waiter leaked in?)")
+
+    old = signal.signal(signal.SIGALRM, _fire)
+    signal.alarm(300)  # selfcheck spawns ~5 interpreters on a slow box
+    yield
+    signal.alarm(0)
+    signal.signal(signal.SIGALRM, old)
+
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_queue", os.path.join(REPO, "scripts", "tpu_queue.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------------------
+# heartbeat / status primitives
+# --------------------------------------------------------------------------
+
+def test_file_heartbeat_roundtrip(tmp_path):
+    path = str(tmp_path / "hb" / "job.json")
+    hb = FileHeartbeat(path)
+    assert heartbeat_age_s(path) is None  # no beat yet
+    hb.beat("step 3")
+    rec = read_heartbeat(path)
+    assert rec["label"] == "step 3" and rec["pid"] == os.getpid()
+    assert heartbeat_age_s(path) < 60.0
+
+
+def test_maybe_job_heartbeat_is_noop_without_env():
+    hb = maybe_job_heartbeat(env={})
+    hb.beat("anything")  # must not write or raise
+    assert hb.path is None
+
+
+def test_maybe_job_heartbeat_binds_env_path(tmp_path):
+    path = str(tmp_path / "hb.json")
+    hb = maybe_job_heartbeat(env={"TPU_QUEUE_HEARTBEAT": path})
+    hb.beat("bound")
+    assert read_heartbeat(path)["label"] == "bound"
+
+
+def test_write_job_status_roundtrip(tmp_path):
+    path = str(tmp_path / "status.json")
+    write_job_status(False, error="UNAVAILABLE: tunnel",
+                     error_class="transient",
+                     env={"TPU_QUEUE_STATUS": path})
+    rec = read_heartbeat(path)
+    assert rec == {"ok": False, "error": "UNAVAILABLE: tunnel",
+                   "error_class": "transient", "t": rec["t"],
+                   "pid": os.getpid()}
+    write_job_status(True, env={})  # no env: must be a silent no-op
+
+
+def test_classifiers_shared_with_train():
+    # train.py re-exports the SAME objects — one classifier, no drift
+    from real_time_helmet_detection_tpu import train as train_mod
+    from real_time_helmet_detection_tpu.runtime import errors
+    assert train_mod.is_transient_backend_error \
+        is errors.is_transient_backend_error
+    assert classify_exception(RuntimeError("UNAVAILABLE: x")) == "transient"
+    assert classify_exception(ValueError("UNAVAILABLE: x")) == "permanent"
+    assert classify_error_text("... UNAVAILABLE: TPU backend ...") \
+        == "transient"
+    # text-only INTERNAL must NOT classify (no type evidence)
+    assert classify_error_text("INTERNAL: assertion") == "permanent"
+
+
+def test_run_as_job_maps_outcomes(tmp_path, monkeypatch):
+    status = str(tmp_path / "s.json")
+    monkeypatch.setenv("TPU_QUEUE_STATUS", status)
+
+    run_as_job(lambda: None)
+    assert read_heartbeat(status)["ok"] is True
+
+    with pytest.raises(SystemExit) as ei:
+        run_as_job(lambda: (_ for _ in ()).throw(
+            RuntimeError("UNAVAILABLE: tunnel died")))
+    assert ei.value.code == EXIT_TRANSIENT
+    assert read_heartbeat(status)["error_class"] == "transient"
+
+    with pytest.raises(SystemExit) as ei:
+        run_as_job(lambda: (_ for _ in ()).throw(ValueError("bad shape")))
+    assert ei.value.code == 1
+    assert read_heartbeat(status)["error_class"] == "permanent"
+
+    # acquire_backend's string SystemExit is a transient (backend) failure
+    with pytest.raises(SystemExit) as ei:
+        run_as_job(lambda: (_ for _ in ()).throw(
+            SystemExit("TPU backend unavailable: probe timed out")))
+    assert ei.value.code == EXIT_TRANSIENT
+
+
+# --------------------------------------------------------------------------
+# CLI surface
+# --------------------------------------------------------------------------
+
+def test_cli_enqueue_and_status(tmp_path, capsys):
+    cli = _load_cli()
+    qdir = str(tmp_path / "q")
+    rc = cli.main(["--queue-dir", qdir, "enqueue", "bench",
+                   "--artifacts", "artifacts/r08/BENCH_*_local.json",
+                   "--heartbeat-timeout", "1200",
+                   "--", "python", "bench.py"])
+    assert rc == 0
+    capsys.readouterr()  # drop the enqueue confirmation
+    rc = cli.main(["--queue-dir", qdir, "status"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["jobs"] == [{
+        "job": "bench", "state": "queued", "attempt": 1,
+        "not_before": None, "argv": "python bench.py"}]
+
+
+def test_cli_enqueue_rejects_duplicate_and_empty(tmp_path):
+    cli = _load_cli()
+    qdir = str(tmp_path / "q")
+    cli.main(["--queue-dir", qdir, "enqueue", "j", "--", "true"])
+    with pytest.raises(ValueError):
+        cli.main(["--queue-dir", qdir, "enqueue", "j", "--", "true"])
+    with pytest.raises(SystemExit):
+        cli.main(["--queue-dir", qdir, "enqueue", "empty"])
+
+
+def test_cli_default_queue_dir_is_round_scoped(monkeypatch):
+    cli = _load_cli()
+    monkeypatch.setenv("GRAFT_ROUND", "r99")
+    assert cli.default_queue_dir().endswith(
+        os.path.join("artifacts", "r99", "queue"))
+
+
+# --------------------------------------------------------------------------
+# the end-to-end proof: real subprocesses through the whole state machine
+# --------------------------------------------------------------------------
+
+def test_selfcheck_end_to_end():
+    """`tpu_queue.py --selfcheck` in a child process, exactly as CI and an
+    operator would run it: ok job -> done; transient job -> requeued then
+    done; hanging job -> killed, salvaged with its flushed partial,
+    requeued, budget exhausted -> failed; journal replay intact."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tpu_queue.py"),
+         "--selfcheck"],
+        capture_output=True, text=True, timeout=280, cwd=REPO)
+    assert r.returncode == 0, "selfcheck failed:\n%s\n%s" % (r.stdout,
+                                                             r.stderr)
+    assert "all checks passed" in r.stdout

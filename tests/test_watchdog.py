@@ -59,3 +59,31 @@ def test_watchdog_disabled_at_zero():
     assert wd._thread is None
     wd.beat("x")
     wd.stop()
+
+
+def test_watchdog_mirrors_beats_to_file(tmp_path):
+    """With beat_file set (the tpu_queue supervisor's contract), every
+    beat/pause/resume lands in the heartbeat file so the job-level
+    supervisor sees the same liveness the in-process watchdog sees."""
+    from real_time_helmet_detection_tpu.runtime import read_heartbeat
+
+    path = str(tmp_path / "hb.json")
+    wd = HangWatchdog(0, beat_file=path)
+    try:
+        assert read_heartbeat(path)["label"] == "start"
+        wd.beat("iter 5")
+        assert read_heartbeat(path)["label"] == "iter 5"
+        wd.pause("ckpt")
+        assert read_heartbeat(path)["label"] == "paused: ckpt"
+        wd.resume("ckpt done")
+        assert read_heartbeat(path)["label"] == "ckpt done"
+    finally:
+        wd.stop()
+
+
+def test_watchdog_reexported_from_runtime():
+    """train.py re-exports the runtime implementation — one watchdog."""
+    from real_time_helmet_detection_tpu.runtime import \
+        HangWatchdog as RuntimeWatchdog
+
+    assert HangWatchdog is RuntimeWatchdog
