@@ -17,7 +17,10 @@ one chip, steady-state:
   analysis vs the chip's peak bf16 FLOP/s;
 * `peak_pallas_us` / `peak_xla_us` — the fused Pallas sigmoid+3x3-peak
   kernel vs the XLA reduce_window path it replaces, plus an on-device
-  bit-identity check.
+  bit-identity check;
+* `donation_ok` — the graftlint trace-audit donation check over the timed
+  train program (analysis/trace_audit.py): every chip run self-reports
+  buffer-aliasing health instead of hiding it in a chip-log warning.
 
 Measurement methodology (round-2 postmortem): on the remote-tunnel `axon`
 backend, `block_until_ready` resolves BEFORE remote execution completes and
@@ -496,6 +499,17 @@ def _bench(out: dict, hb) -> None:
             state, *arrs).compile()
         train_flops = flops_of(tcompiled)
         train_bytes = bytes_of(tcompiled)  # scan body counted once -> /step
+        try:
+            # donation_ok: chip runs self-report aliasing health in the
+            # ONE JSON line — the trace-audit aval check (graftlint layer
+            # 1), eval_shape only, no device work. False would mean the
+            # timed program holds TWO states in HBM and the chip log
+            # carries the "donated buffers were not usable" warning.
+            from real_time_helmet_detection_tpu.analysis.trace_audit import \
+                donation_ok
+            out["donation_ok"] = donation_ok(train_n, (0,), (state, *arrs))
+        except Exception as e:  # noqa: BLE001 — never block the bench
+            log("donation audit unavailable: %r" % e)
         # warmup run consumes (donates) `state`; rebuild for the timed run.
         # The program returns (final state, last loss) so every donated
         # buffer has an output to alias (donation actually elides the

@@ -1,5 +1,8 @@
 """Host input-pipeline throughput: thread vs process loader A/B.
 
+Measures the TPU-side replacements for the reference's input pipeline
+(torch DataLoader worker processes + pin_memory, ref train.py:39-44).
+
 Successor to the r5 snapshot (artifacts/r05/calibration/host_loader_bench.py,
 which measured the thread loader only and put the "budget ~9 host cores per
 chip" number on the input-bound risk). This maintained version adds the

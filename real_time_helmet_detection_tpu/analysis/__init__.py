@@ -1,0 +1,94 @@
+"""Static analysis: trace-level jit hygiene + repo-convention linting.
+
+Every campaign loss so far traced to a *class* of mistake that is
+mechanically detectable before a chip-second is spent (eager per-op
+dispatch, per-call wall-clock timing, un-donated buffers, ad-hoc chip
+invocations, non-atomic artifact writes — CLAUDE.md's hard-won rules).
+The reference has no analysis tooling at all; its closest artifact is the
+manual self-test (ref hourglass.py:241-256). This package CHECKS the
+invariants instead of remembering them:
+
+* `ast_rules`   — stdlib-`ast` convention rules over the repo source
+                  (importable with zero jax dependency)
+* `trace_audit` — abstract traces of the public entry points via
+                  `jax.eval_shape` / `jit(...).lower()`, inspected at the
+                  jaxpr + StableHLO level (CPU-only, no TPU contact)
+
+Findings diff against the committed `analysis/baseline.json`, so the CI
+gate (tests/test_graftlint.py + `scripts/graftlint.py`) is ratchet-only:
+new findings fail, baselined ones are individually justified entries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation. `key` (rule::path::context) intentionally
+    excludes the line number so baseline entries survive unrelated edits
+    to the same file; `line` is for humans reading the report."""
+
+    rule: str          # e.g. "ast/raw-artifact-write", "trace/donation"
+    path: str          # repo-relative file, or "<entry>" for trace rules
+    message: str
+    line: int = 0
+    context: str = ""  # enclosing def/class qualname, or trace entry name
+
+    @property
+    def key(self) -> str:
+        return "%s::%s::%s" % (self.rule, self.path, self.context)
+
+    def as_dict(self) -> Dict:
+        d = dataclasses.asdict(self)
+        d["key"] = self.key
+        return d
+
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, str]:
+    """key -> justification from the committed baseline (empty if absent:
+    a missing baseline means NOTHING is grandfathered)."""
+    path = path or BASELINE_PATH
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    return {e["key"]: e.get("reason", "") for e in data.get("findings", [])}
+
+
+def diff_baseline(findings: List[Finding],
+                  baseline: Dict[str, str]) -> Dict[str, List]:
+    """Ratchet diff: `new` fails the gate; `baselined` is tolerated;
+    `stale` are baseline entries no longer observed (safe to drop — the
+    ratchet only ever tightens)."""
+    seen = {f.key for f in findings}
+    new = [f for f in findings if f.key not in baseline]
+    baselined = [f for f in findings if f.key in baseline]
+    stale = sorted(k for k in baseline if k not in seen)
+    return {"new": new, "baselined": baselined, "stale": stale}
+
+
+def write_baseline(findings: List[Finding], path: Optional[str] = None,
+                   reasons: Optional[Dict[str, str]] = None) -> str:
+    """Regenerate baseline.json from the current findings (the ratchet
+    reset — review each entry's justification before committing). Atomic
+    via utils.atomic_write_bytes, per the repo's own rule."""
+    from ..utils import save_json
+    path = path or BASELINE_PATH
+    reasons = reasons or {}
+    entries = [{"key": f.key, "rule": f.rule, "path": f.path,
+                "context": f.context,
+                "reason": reasons.get(f.key, "baselined at introduction; "
+                                             "justify or fix")}
+               for f in sorted(findings, key=lambda f: f.key)]
+    save_json(path, {"version": 1, "findings": entries}, indent=1,
+              sort_keys=True)
+    return path

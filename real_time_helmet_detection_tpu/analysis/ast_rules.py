@@ -1,0 +1,395 @@
+"""AST convention rules (graftlint layer 2) — stdlib `ast` only, no jax.
+
+Each rule mechanizes one hard-won repo convention (CLAUDE.md "Environment
+pitfalls"; the reference repo has no conventions to lint — its closest
+analogue is manual code review, ref /root/reference/README.md:1):
+
+* `per-call-timing`     — wall-clock timing bracketing a device fetch in
+                          one function: on the remote-tunnel backend,
+                          completion events resolve BEFORE execution, so
+                          per-call timing measures nothing real. Use
+                          `bench.timed_fetch` / `measure_dispatch_overhead`
+                          (the allowlisted implementations).
+* `queue-bypass`        — a chip-touching script (acquires a backend)
+                          without the job-supervision contract
+                          (`run_as_job` / `maybe_job_heartbeat`): ad-hoc
+                          chip invocations are how r2/r3/r7 lost their
+                          campaigns (scripts/tpu_queue.py is the front-end).
+* `env-platform-write`  — writing JAX_PLATFORMS into os.environ: the
+                          image's sitecustomize pins the platform before
+                          user code runs, so the env write silently does
+                          nothing. Use `jax.config.update("jax_platforms",
+                          ...)` or the CLI `--platform`.
+* `raw-artifact-write`  — `open(..., "w"/"wb")` writes outside
+                          `utils.save_json`/`atomic_write_bytes`: a kill
+                          mid-write leaves a truncated artifact where a
+                          complete one stood, and the salvage path trusts
+                          every file it finds.
+* `device-get-in-loop`  — `jax.device_get` inside a per-step loop outside
+                          the allowlisted modules: each materializing
+                          fetch is a host<->device sync (~70 ms tunnel
+                          round trip) that breaks async dispatch.
+* `missing-ref-citation`— public module docstring without a reference
+                          citation (`ref <file:line>` / `/root/reference`
+                          path / an explicit no-analogue statement): the
+                          parity-checkability convention (CLAUDE.md).
+
+Suppression: a `# graftlint: off=<rule>[,<rule>]` comment anywhere inside
+the flagged node's line span disables that rule there — every suppression
+should carry a nearby justification comment, exactly like a baseline
+entry.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from . import Finding
+
+# ---------------------------------------------------------------------------
+# scope: which files each rule applies to (paths repo-relative, "/"-sep)
+
+EXCLUDE_DIRS = {"tests", "artifacts", "build", "cpp", "docs", ".git",
+                "__pycache__", ".claude"}
+
+# chip-touching scripts: must run under the job-supervision contract
+QUEUE_RULE_PREFIXES = ("scripts/",)
+QUEUE_RULE_FILES = {"bench.py", "scaling.py"}
+
+# documented exemptions, mirrored in docs/ARCHITECTURE.md's rule table:
+TIMING_ALLOW = {
+    # THE sanctioned timing harness: scan-inside-one-program + scalar
+    # fetch minus measured dispatch overhead (bench.py module docstring)
+    "bench.py::measure_dispatch_overhead",
+    "bench.py::timed_fetch",
+    "bench.py::chain_timed_fetch",
+}
+DEVICE_GET_LOOP_ALLOW = {
+    # software-pipelined eval loop: the device_get IS the designed
+    # completion point for batch i while batch i+1 computes
+    "real_time_helmet_detection_tpu/evaluate.py",
+    # deferred loss flush every print_interval steps + epoch-boundary
+    # scalar fetches — the documented alternative to a per-step sync
+    "real_time_helmet_detection_tpu/train.py",
+}
+RAW_WRITE_ALLOW = {
+    # the atomic-write implementation itself
+    "real_time_helmet_detection_tpu/utils.py",
+}
+
+_REF_PATTERNS = (
+    re.compile(r"\bref\s+\S+:\d"),             # "ref train.py:86"
+    re.compile(r"/root/reference/\S+\.\w+"),   # "/root/reference/data.py"
+    re.compile(r"reference\s+has\s+no", re.I),
+    re.compile(r"no\s+reference\s+analogue", re.I),
+)
+
+_SUPPRESS_RE = re.compile(r"#.*graftlint:\s*off=([\w,/-]+)")
+
+_TIMING_FNS = {"time", "perf_counter", "monotonic"}
+_FETCH_ATTRS = {"device_get", "block_until_ready"}
+
+
+def _suppressed(rule: str, lines: Sequence[str], lo: int, hi: int) -> bool:
+    """Is `rule` switched off by a `# graftlint: off=` marker in
+    source lines [lo, hi] (1-based, inclusive)?"""
+    short = rule.split("/", 1)[-1]
+    for ln in lines[max(0, lo - 1):hi]:
+        m = _SUPPRESS_RE.search(ln)
+        if m and short in m.group(1).split(","):
+            return True
+    return False
+
+
+def _node_span(node: ast.AST) -> Tuple[int, int]:
+    lo = getattr(node, "lineno", 1)
+    hi = getattr(node, "end_lineno", lo)
+    return lo, hi
+
+
+def _call_name(node: ast.Call) -> str:
+    """Dotted name of a call target, best effort ("time.perf_counter",
+    "jax.device_get", "open", ...)."""
+    parts: List[str] = []
+    t = node.func
+    while isinstance(t, ast.Attribute):
+        parts.append(t.attr)
+        t = t.value
+    if isinstance(t, ast.Name):
+        parts.append(t.id)
+    return ".".join(reversed(parts))
+
+
+def _iter_scopes(tree: ast.Module) -> Iterable[Tuple[str, ast.AST,
+                                                     List[ast.stmt]]]:
+    """(qualname, node, body) for the module and every (nested) function/
+    class scope. Each function's body EXCLUDES nested function bodies, so
+    a pattern split across an outer function and its closure does not
+    double-report."""
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = (prefix + "." + child.name) if prefix else child.name
+                yield qual, child, child.body
+                yield from walk(child, qual)
+            else:
+                yield from walk(child, prefix)
+
+    yield "module", tree, tree.body
+    yield from walk(tree, "")
+
+
+def _scope_calls(body: List[ast.stmt]) -> Iterable[ast.Call]:
+    """Every Call in a scope body, NOT descending into nested defs."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# the rules
+
+
+def rule_per_call_timing(tree, lines, relpath) -> List[Finding]:
+    out = []
+    for qual, node, body in _iter_scopes(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if "%s::%s" % (relpath, qual) in TIMING_ALLOW \
+                or "%s::%s" % (os.path.basename(relpath), qual) \
+                in TIMING_ALLOW:
+            continue
+        timing_line = fetch_line = 0
+        for call in _scope_calls(body):
+            name = _call_name(call)
+            if name.startswith("time.") and name.split(".")[-1] \
+                    in _TIMING_FNS:
+                timing_line = timing_line or call.lineno
+            if name.split(".")[-1] in _FETCH_ATTRS:
+                fetch_line = fetch_line or call.lineno
+        if timing_line and fetch_line:
+            lo, hi = _node_span(node)
+            if _suppressed("per-call-timing", lines, lo, hi):
+                continue
+            out.append(Finding(
+                rule="ast/per-call-timing", path=relpath,
+                line=min(timing_line, fetch_line), context=qual,
+                message="wall-clock timing and a device fetch in one "
+                        "function: per-call timing is meaningless on the "
+                        "remote tunnel (completion events resolve early) "
+                        "— use bench.timed_fetch / a scanned program"))
+    return out
+
+
+def rule_queue_bypass(tree, lines, relpath) -> List[Finding]:
+    if not (relpath in QUEUE_RULE_FILES
+            or any(relpath.startswith(p) for p in QUEUE_RULE_PREFIXES)):
+        return []
+    acquire_line = 0
+    supervised = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name.endswith("acquire_backend") or name == "jax.devices":
+                acquire_line = acquire_line or node.lineno
+        if isinstance(node, ast.Name) and node.id in ("run_as_job",
+                                                      "maybe_job_heartbeat"):
+            supervised = True
+        if isinstance(node, ast.Attribute) and node.attr in (
+                "run_as_job", "maybe_job_heartbeat"):
+            supervised = True
+    if acquire_line and not supervised:
+        if _suppressed("queue-bypass", lines, 1, len(lines)):
+            return []
+        return [Finding(
+            rule="ast/queue-bypass", path=relpath, line=acquire_line,
+            context="module",
+            message="script acquires a backend but never touches the job "
+                    "supervision contract (run_as_job / "
+                    "maybe_job_heartbeat): chip jobs go through "
+                    "scripts/tpu_queue.py, which needs the heartbeat to "
+                    "distinguish slow from hung")]
+    return []
+
+
+def rule_env_platform_write(tree, lines, relpath) -> List[Finding]:
+    out = []
+
+    def environ_key(sub: ast.AST) -> Optional[str]:
+        """'JAX_PLATFORMS' if `sub` is os.environ[...] with that key."""
+        if isinstance(sub, ast.Subscript) \
+                and isinstance(sub.value, ast.Attribute) \
+                and sub.value.attr == "environ":
+            sl = sub.slice
+            if isinstance(sl, ast.Constant) and sl.value == "JAX_PLATFORMS":
+                return sl.value
+        return None
+
+    for node in ast.walk(tree):
+        hit = 0
+        if isinstance(node, ast.Assign):
+            if any(environ_key(t) for t in node.targets):
+                hit = node.lineno
+        elif isinstance(node, ast.Call):
+            name = _call_name(node)
+            first = node.args[0] if node.args else None
+            is_jp = isinstance(first, ast.Constant) \
+                and first.value == "JAX_PLATFORMS"
+            if name.endswith("environ.setdefault") and is_jp:
+                hit = node.lineno
+            elif name.endswith("putenv") and is_jp:
+                hit = node.lineno
+        if hit and not _suppressed("env-platform-write", lines, hit, hit):
+            out.append(Finding(
+                rule="ast/env-platform-write", path=relpath, line=hit,
+                context="module",
+                message="os.environ write of JAX_PLATFORMS does nothing "
+                        "here (sitecustomize pinned the platform at "
+                        "startup) — use jax.config.update('jax_platforms',"
+                        " ...) or the --platform flag"))
+    return out
+
+
+def rule_raw_artifact_write(tree, lines, relpath) -> List[Finding]:
+    if relpath in RAW_WRITE_ALLOW:
+        return []
+    out = []
+    for qual, node, body in _iter_scopes(tree):
+        if isinstance(node, ast.ClassDef):
+            continue
+        for call in _scope_calls(body):
+            if _call_name(call) != "open":
+                continue
+            mode = None
+            if len(call.args) >= 2 and isinstance(call.args[1],
+                                                  ast.Constant):
+                mode = call.args[1].value
+            for kw in call.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if not (isinstance(mode, str) and "w" in mode):
+                continue
+            if _suppressed("raw-artifact-write", lines, call.lineno,
+                           getattr(call, "end_lineno", call.lineno)):
+                continue
+            out.append(Finding(
+                rule="ast/raw-artifact-write", path=relpath,
+                line=call.lineno, context=qual,
+                message="raw open(..., %r) write: a kill mid-write leaves "
+                        "a truncated file where a complete one stood — "
+                        "use utils.save_json / atomic_write_bytes "
+                        "(tmp + os.replace)" % mode))
+    return out
+
+
+def rule_device_get_in_loop(tree, lines, relpath) -> List[Finding]:
+    if relpath in DEVICE_GET_LOOP_ALLOW:
+        return []
+    out = []
+    for qual, node, body in _iter_scopes(tree):
+        stack: List[ast.AST] = list(body)
+        loops: List[ast.AST] = []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(n, (ast.For, ast.While)):
+                loops.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        for loop in loops:
+            for call in _scope_calls(loop.body):
+                if _call_name(call).split(".")[-1] != "device_get":
+                    continue
+                if _suppressed("device-get-in-loop", lines, call.lineno,
+                               getattr(call, "end_lineno", call.lineno)):
+                    continue
+                out.append(Finding(
+                    rule="ast/device-get-in-loop", path=relpath,
+                    line=call.lineno, context=qual,
+                    message="jax.device_get inside a loop forces a "
+                            "host<->device sync every iteration (~70 ms "
+                            "tunnel round trip each) — batch the fetch "
+                            "(deferred flush) or pipeline it"))
+    return out
+
+
+def rule_missing_ref_citation(tree, lines, relpath) -> List[Finding]:
+    if os.path.basename(relpath) == "__init__.py":
+        return []  # namespace modules: the citation lives in the members
+    doc = ast.get_docstring(tree) or ""
+    if any(p.search(doc) for p in _REF_PATTERNS):
+        return []
+    if _suppressed("missing-ref-citation", lines, 1,
+                   min(len(lines), 3)):
+        return []
+    return [Finding(
+        rule="ast/missing-ref-citation", path=relpath, line=1,
+        context="module",
+        message="public module docstring has no reference citation: add "
+                "`ref <file:line>` (into /root/reference) or state the "
+                "reference has no analogue (CLAUDE.md convention)")]
+
+
+RULES = (rule_per_call_timing, rule_queue_bypass, rule_env_platform_write,
+         rule_raw_artifact_write, rule_device_get_in_loop,
+         rule_missing_ref_citation)
+
+
+# ---------------------------------------------------------------------------
+# drivers
+
+
+def lint_source(src: str, relpath: str,
+                rules=RULES) -> List[Finding]:
+    """Run `rules` over one file's source. Unparseable source is itself a
+    finding (a syntax error in prod code must not pass silently)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(rule="ast/syntax-error", path=relpath,
+                        line=e.lineno or 0, context="module",
+                        message="unparseable: %s" % e.msg)]
+    lines = src.splitlines()
+    out: List[Finding] = []
+    for rule in rules:
+        out.extend(rule(tree, lines, relpath))
+    return out
+
+
+def repo_files(root: str) -> List[str]:
+    """Repo-relative production .py files in lint scope (tests, committed
+    artifacts, build outputs excluded — their conventions differ)."""
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel = os.path.relpath(dirpath, root)
+        parts = [] if rel == "." else rel.split(os.sep)
+        if parts and (parts[0] in EXCLUDE_DIRS
+                      or any(p in EXCLUDE_DIRS for p in parts)):
+            dirnames[:] = []
+            continue
+        dirnames[:] = [d for d in dirnames if d not in EXCLUDE_DIRS]
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                p = os.path.normpath(os.path.join(rel, f)) if parts else f
+                out.append(p.replace(os.sep, "/"))
+    return sorted(out)
+
+
+def lint_repo(root: str, rules=RULES) -> List[Finding]:
+    out: List[Finding] = []
+    for rel in repo_files(root):
+        with open(os.path.join(root, rel)) as f:
+            src = f.read()
+        out.extend(lint_source(src, rel, rules))
+    return out

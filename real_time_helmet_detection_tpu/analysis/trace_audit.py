@@ -1,0 +1,395 @@
+"""Trace-level jit-hygiene audit (graftlint layer 1) — CPU-only, no chip.
+
+Abstractly traces the public entry points (scanned train step, predict /
+eval chain, export path — the same programs bench.py times and the C++
+runner executes) via `jax.make_jaxpr` / `jit(...).lower()` and inspects
+the jaxpr + StableHLO for the mistake classes that cost real campaigns
+(CLAUDE.md; the reference has no compile-model to audit — its eval loops
+eagerly per batch item, ref /root/reference/evaluate.py:66-97):
+
+* `trace/dynamic-shape`    — dynamic dims in the lowered StableHLO
+                             (violates the fixed-shapes/masks law that
+                             keeps eval recompile-free)
+* `trace/trace-failure`    — the entry point no longer traces at all
+                             (how boolean filtering manifests: jax raises
+                             NonConcreteBooleanIndexError at trace time)
+* `trace/f64`              — float64/complex128 avals: a silent x64 leak
+                             doubles every buffer and falls off the TPU
+                             fast path
+* `trace/host-callback`    — callback/infeed primitives inside a hot
+                             path: each invocation is a host round trip
+                             (~70 ms on the remote tunnel) per step
+* `trace/donation`         — a donated argument with no matching output
+                             aval: XLA cannot alias it, the copy stays,
+                             and the chip log grows a "Some donated
+                             buffers were not usable" warning mid-run —
+                             caught here at trace time instead
+* `trace/retrace-unstable` — tracing the same entry twice (and across the
+                             tpu_sweep-representative config grid) yields
+                             different trace signatures: trace-time
+                             nondeterminism (clock/RNG/dict-order in
+                             closures) makes EVERY jit call a potential
+                             recompile
+
+All audits run on tiny-shape CPU models: `jax.eval_shape` / `.lower()`
+never execute device code, so a full audit costs seconds and zero TPU
+contact.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import Finding
+
+_CALLBACK_PRIMS = ("callback", "outside_call", "infeed", "outfeed",
+                   "host_local_array_to_global_array")
+_BAD_DTYPES = ("float64", "complex128")
+
+
+# ---------------------------------------------------------------------------
+# primitives
+
+
+def trace_signature(fn: Callable, args: Sequence) -> str:
+    """sha256 of the canonicalized jaxpr text: stable across retraces of
+    a deterministic trace (jaxpr var names are assigned canonically), and
+    a different program -> a different hash. Constants participate — a
+    trace-time `random()` constant is exactly the hazard to catch."""
+    import jax
+    # a FRESH wrapper per call: jax caches traces on function identity,
+    # so retracing the same object would be vacuously stable — the hazard
+    # being checked is a REBUILT entry (new epoch / new process / re-JIT
+    # after clear_caches) tracing to a different program
+    jaxpr = jax.make_jaxpr(lambda *a: fn(*a))(*args)
+    # printed object addresses (custom_jvp thunks etc.) are process noise,
+    # not program content — mask them or every custom_vjp'd model would
+    # read as unstable
+    text = re.sub(r" at 0x[0-9a-f]+", " at 0xX", str(jaxpr))
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def _walk_jaxprs(jaxpr):
+    """The jaxpr plus every sub-jaxpr closed over by its equations
+    (scan/while/cond bodies, custom_vjp branches, pjit callees...)."""
+    seen = []
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        seen.append(j)
+        for eqn in j.eqns:
+            for v in eqn.params.values():
+                for cand in (v if isinstance(v, (list, tuple)) else (v,)):
+                    inner = getattr(cand, "jaxpr", cand)
+                    if hasattr(inner, "eqns"):
+                        stack.append(inner)
+    return seen
+
+
+def jaxpr_findings(fn: Callable, args: Sequence, entry: str) -> List[Finding]:
+    """f64 avals + host-callback primitives, recursively through every
+    closed-over sub-jaxpr."""
+    import jax
+    closed = jax.make_jaxpr(fn)(*args)
+    out: List[Finding] = []
+    f64_hit = False
+    cb_seen = set()
+    for j in _walk_jaxprs(closed.jaxpr):
+        for eqn in j.eqns:
+            prim = eqn.primitive.name
+            if any(tok in prim for tok in _CALLBACK_PRIMS) \
+                    and prim not in cb_seen:
+                cb_seen.add(prim)
+                out.append(Finding(
+                    rule="trace/host-callback", path="<%s>" % entry,
+                    context=entry,
+                    message="primitive %r in the traced program: every "
+                            "invocation is a host round trip inside the "
+                            "hot path" % prim))
+            if not f64_hit:
+                for v in tuple(eqn.outvars) + tuple(eqn.invars):
+                    dt = getattr(getattr(v, "aval", None), "dtype", None)
+                    if dt is not None and str(dt) in _BAD_DTYPES:
+                        f64_hit = True
+                        out.append(Finding(
+                            rule="trace/f64", path="<%s>" % entry,
+                            context=entry,
+                            message="%s aval in the traced program "
+                                    "(primitive %r): silent wide-dtype "
+                                    "promotion — pin dtypes; x64 must "
+                                    "stay off" % (dt, prim)))
+                        break
+    return out
+
+
+def stablehlo_findings(fn: Callable, args: Sequence, entry: str,
+                       donate_argnums: Tuple[int, ...] = ()) -> List[Finding]:
+    """Lower (never compile/execute) and scan the StableHLO text for
+    dynamic dims. f64 leaks are caught at the jaxpr level; the text scan
+    here is only for shapes, where the jaxpr can't see what lowering
+    decided."""
+    import jax
+    text = jax.jit(fn, donate_argnums=donate_argnums).lower(
+        *args).as_text()
+    out = []
+    if "tensor<?" in text or "x?x" in text:
+        out.append(Finding(
+            rule="trace/dynamic-shape", path="<%s>" % entry, context=entry,
+            message="dynamic dimension in lowered StableHLO: violates the "
+                    "fixed-shapes/masks convention (every retrace with a "
+                    "new shape is a fresh XLA compile)"))
+    return out
+
+
+def donation_mismatches(fn: Callable, donate_argnums: Sequence[int],
+                        args: Sequence) -> List[str]:
+    """Donated input leaves with no same-(shape, dtype) output leaf to
+    alias. Aval matching is the lintable approximation of XLA's
+    usability rule (layout/sharding also participate on-device); an aval
+    mismatch here is ALWAYS a real donation failure."""
+    import jax
+
+    out_shape = jax.eval_shape(fn, *args)
+    out_leaves = jax.tree.leaves(out_shape)
+    pool: Dict[Tuple, int] = {}
+    for leaf in out_leaves:
+        key = (tuple(leaf.shape), str(leaf.dtype))
+        pool[key] = pool.get(key, 0) + 1
+    missing = []
+    for i in donate_argnums:
+        for leaf in jax.tree.leaves(jax.eval_shape(lambda x: x, args[i])):
+            key = (tuple(leaf.shape), str(leaf.dtype))
+            if pool.get(key, 0) > 0:
+                pool[key] -= 1
+            else:
+                missing.append("arg %d leaf %s%s" % (i, key[1],
+                                                     list(key[0])))
+    return missing
+
+
+def donation_ok(fn: Callable, donate_argnums: Sequence[int],
+                args: Sequence) -> bool:
+    """True when every donated buffer has an aliasing target — the
+    `donation_ok` field bench.py's ONE JSON line reports."""
+    try:
+        return not donation_mismatches(fn, donate_argnums, args)
+    except Exception:  # noqa: BLE001 — an unanalyzable fn is not "ok"
+        return False
+
+
+def donation_findings(fn: Callable, donate_argnums: Sequence[int],
+                      args: Sequence, entry: str) -> List[Finding]:
+    missing = donation_mismatches(fn, donate_argnums, args)
+    if not missing:
+        return []
+    return [Finding(
+        rule="trace/donation", path="<%s>" % entry, context=entry,
+        message="donated buffers with no matching output aval (the copy "
+                "cannot be elided; 'Some donated buffers were not "
+                "usable' at run time): %s" % "; ".join(missing[:4]))]
+
+
+def retrace_findings(fn: Callable, args: Sequence, entry: str) -> List[Finding]:
+    sig_a = trace_signature(fn, args)
+    sig_b = trace_signature(fn, args)
+    if sig_a == sig_b:
+        return []
+    return [Finding(
+        rule="trace/retrace-unstable", path="<%s>" % entry, context=entry,
+        message="two traces of the same entry with identical avals "
+                "produced different jaxprs: trace-time nondeterminism "
+                "(clock/RNG/dict order) — every jit call may recompile")]
+
+
+def audit_entry(fn: Callable, args: Sequence, entry: str,
+                donate_argnums: Tuple[int, ...] = (),
+                lower: bool = True) -> List[Finding]:
+    """All trace rules over one entry point. A trace failure IS a finding
+    (boolean filtering / concretization errors surface here), never an
+    audit crash."""
+    try:
+        out = jaxpr_findings(fn, args, entry)
+        out += retrace_findings(fn, args, entry)
+        if donate_argnums:
+            out += donation_findings(fn, donate_argnums, args, entry)
+        if lower:
+            out += stablehlo_findings(fn, args, entry, donate_argnums)
+        return out
+    except Exception as e:  # noqa: BLE001 — the failure is the finding
+        return [Finding(
+            rule="trace/trace-failure", path="<%s>" % entry, context=entry,
+            message="entry point failed to trace (%s: %s) — boolean "
+                    "filtering / shape dynamism / a broken entry point"
+                    % (type(e).__name__,
+                       (str(e).splitlines() or ["?"])[0][:200]))]
+
+
+# ---------------------------------------------------------------------------
+# the repo's entry points, tiny-shape CPU editions
+
+# The remat policies of tpu_sweep's CPU-representative step_grid (its
+# `grid` when not on_tpu, scripts/tpu_sweep.py `step_grid` section). The
+# loss kernel is pinned to "xla" here: the fused Pallas kernel off-TPU
+# runs in interpret mode, whose trace drags in interpreter internals that
+# are not what ships to the chip.
+STEP_GRID_REMAT = ("none", "stacks", "full")
+_TINY = dict(num_stack=1, hourglass_inch=16, num_cls=2, imsize=64)
+_BATCH = 2
+
+
+def _tiny_train_parts(remat: str = "none"):
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import Config
+    from ..data import synthetic_target_batch
+    from ..models import build_model
+    from ..optim import build_optimizer
+    from ..train import (create_train_state, make_scanned_train_fn,
+                         make_train_step_body)
+
+    cfg = Config(batch_size=_BATCH, remat=remat, loss_kernel="xla", **_TINY)
+    model = build_model(cfg)
+    tx = build_optimizer(cfg, 10)
+    state = create_train_state(model, cfg, jax.random.key(0),
+                               _TINY["imsize"], tx)
+    body = make_train_step_body(model, tx, cfg)
+    train_n = make_scanned_train_fn(body, 2)
+    arrs = tuple(jnp.asarray(a) for a in synthetic_target_batch(
+        _BATCH, _TINY["imsize"], pos_rate=0.05))
+    return train_n, (state,) + arrs
+
+
+def _tiny_predict_parts(normalize: Optional[str] = None):
+    import jax
+    import numpy as np
+
+    from ..config import Config
+    from ..models import build_model
+    from ..predict import make_predict_fn
+    from ..train import init_variables
+
+    cfg = Config(topk=16, conf_th=0.0, nms_th=0.5, **_TINY)
+    model = build_model(cfg)
+    params, batch_stats = init_variables(model, jax.random.key(0),
+                                         _TINY["imsize"])
+    variables = {"params": params, "batch_stats": batch_stats}
+    predict = make_predict_fn(model, cfg, normalize=normalize)
+    if normalize:
+        images = np.zeros((_BATCH, _TINY["imsize"], _TINY["imsize"], 3),
+                          np.uint8)
+    else:
+        images = np.zeros((_BATCH, _TINY["imsize"], _TINY["imsize"], 3),
+                          np.float32)
+    return predict, variables, images
+
+
+def _predict_chain(predict, n: int = 2):
+    """bench.py's donating predict-chain contract (make_predict_chain):
+    images donated, final carry returned as the aliasing target."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def prog(variables, images):
+        def body(imgs, _):
+            det = predict(variables, imgs)
+            eps = (jnp.tanh(jnp.sum(det.scores)) * 1e-12).astype(imgs.dtype)
+            return imgs + eps, ()
+        final, _ = lax.scan(body, images, None, length=n)
+        return final, jnp.sum(final[0, 0, 0])
+    return prog
+
+
+def audit_repo_entry_points(lower: bool = True) -> List[Finding]:
+    """Trace-audit every public entry point at tiny CPU shapes.
+
+    Entries mirror the production surfaces: the scanned train step
+    (bench.py/scaling.py's timed program) across the tpu_sweep
+    step-grid remat policies, the jitted predict fn (eval), the donating
+    predict chain (bench), the raw-uint8-wire predict (eval driver /
+    export --export-raw-input), and the export fn (the C++ runner's
+    artifact)."""
+    findings: List[Finding] = []
+    grid_sigs: Dict[str, str] = {}
+
+    for remat in STEP_GRID_REMAT:
+        entry = "train_step_scanned[remat=%s]" % remat
+        try:
+            train_n, targs = _tiny_train_parts(remat)
+        except Exception as e:  # noqa: BLE001
+            findings.append(Finding(
+                rule="trace/trace-failure", path="<%s>" % entry,
+                context=entry,
+                message="entry construction failed: %s: %s"
+                        % (type(e).__name__,
+                           (str(e).splitlines() or ["?"])[0][:200])))
+            continue
+        # lower only the default policy: remat variants share the same
+        # shape surface and the StableHLO scan is the slow part
+        findings += audit_entry(train_n, targs, entry,
+                                donate_argnums=(0,),
+                                lower=lower and remat == "none")
+        try:
+            grid_sigs[entry] = trace_signature(train_n, targs)
+        except Exception:  # noqa: BLE001 — already reported above
+            pass
+
+    # distinct static configs must trace to distinct programs; a collision
+    # means a policy knob silently did nothing (the inverse hazard of
+    # retrace instability, same census)
+    by_sig: Dict[str, List[str]] = {}
+    for entry, sig in grid_sigs.items():
+        by_sig.setdefault(sig, []).append(entry)
+    for sig, entries in by_sig.items():
+        if len(entries) > 1 and "remat=none" not in " ".join(entries):
+            findings.append(Finding(
+                rule="trace/retrace-unstable", path="<step_grid>",
+                context="step_grid",
+                message="distinct remat policies traced to the SAME "
+                        "program (%s): the policy knob is dead"
+                        % ", ".join(sorted(entries))))
+
+    try:
+        predict, variables, images = _tiny_predict_parts()
+        findings += audit_entry(
+            lambda v, im: predict(v, im), (variables, images), "predict",
+            lower=lower)
+        chain = _predict_chain(predict)
+        findings += audit_entry(chain, (variables, images),
+                                "predict_chain", donate_argnums=(1,),
+                                lower=lower)
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            rule="trace/trace-failure", path="<predict>", context="predict",
+            message="entry construction failed: %s: %s"
+                    % (type(e).__name__,
+                       (str(e).splitlines() or ["?"])[0][:200])))
+
+    try:
+        predict_raw, variables_r, images_u8 = _tiny_predict_parts(
+            normalize="imagenet")
+        findings += audit_entry(
+            lambda v, im: predict_raw(v, im), (variables_r, images_u8),
+            "predict_raw_wire", lower=lower)
+
+        from ..config import Config
+        from ..export import build_export_fn
+        from ..models import build_model
+        ecfg = Config(topk=16, **_TINY)
+        emodel = build_model(ecfg)
+        efn = build_export_fn(emodel, variables_r, ecfg,
+                              normalize="imagenet")
+        findings += audit_entry(efn, (images_u8,), "export_predict",
+                                lower=lower)
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            rule="trace/trace-failure", path="<export_predict>",
+            context="export_predict",
+            message="entry construction failed: %s: %s"
+                    % (type(e).__name__,
+                       (str(e).splitlines() or ["?"])[0][:200])))
+
+    return findings

@@ -16,6 +16,7 @@ from typing import Tuple
 import numpy as np
 from PIL import Image, ImageDraw
 
+from ..utils import atomic_write_bytes
 from .voc import INDEX2CLASS
 
 _XML = """<annotation>
@@ -263,11 +264,14 @@ def make_synthetic_voc(root: str, num_train: int = 8, num_test: int = 4,
                 _OBJ.format(name=INDEX2CLASS[cls], x1=x1, y1=y1, x2=x2, y2=y2)
                 for cls, x1, y1, x2, y2 in boxes]
             img.save(os.path.join(img_dir, fname + ".jpg"), quality=quality)
-            with open(os.path.join(ann_dir, fname + ".xml"), "w") as f:
-                f.write(_XML.format(fname=fname, w=w, h=h,
-                                    objects="".join(objects)))
-        with open(os.path.join(set_dir, split + ".txt"), "w") as f:
-            f.write("\n".join(names) + "\n")
+            # atomic: a killed fixture build must not leave a truncated
+            # XML that poisons the next run's parse (see utils)
+            atomic_write_bytes(
+                os.path.join(ann_dir, fname + ".xml"),
+                _XML.format(fname=fname, w=w, h=h,
+                            objects="".join(objects)).encode())
+        atomic_write_bytes(os.path.join(set_dir, split + ".txt"),
+                           ("\n".join(names) + "\n").encode())
     return root
 
 

@@ -131,7 +131,9 @@ def evaluate(cfg: Config) -> Dict:
     gt_labels: Dict[str, np.ndarray] = {}
     # "dispatch" = async predict dispatch only (not inference latency —
     # bench.py measures that); "consume" = device_get wait + host box
-    # rescale/txt writes for the previous batch
+    # rescale/txt writes for the previous batch. These are host-side
+    # pipeline meters by design, not device timing (bench.py owns that):
+    # graftlint: off=per-call-timing
     meters = {k: AverageMeter() for k in ("data", "dispatch", "consume")}
 
     imsize = float(cfg.imsize or 512)

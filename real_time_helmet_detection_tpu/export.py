@@ -23,7 +23,6 @@ enforced by tests/test_export.py.
 
 from __future__ import annotations
 
-import json
 import os
 from typing import Optional, Tuple
 
@@ -33,6 +32,7 @@ import numpy as np
 
 from .config import Config
 from .predict import make_predict_fn
+from .utils import atomic_write_bytes, save_json
 
 
 def build_export_fn(model, variables, cfg: Config,
@@ -80,41 +80,41 @@ def export_predict(cfg: Config, out_dir: Optional[str] = None,
     from jax import export as jax_export
     exported = jax_export.export(jax.jit(fn))(spec)
 
+    # atomic (tmp + os.replace) like every other artifact write: the C++
+    # runner and runner_drive.py trust any file they find at these paths,
+    # and a kill mid-write must never leave a truncated program there
     bin_path = os.path.join(out_dir, "exported_predict.bin")
-    with open(bin_path, "wb") as f:
-        f.write(exported.serialize())
+    atomic_write_bytes(bin_path, exported.serialize())
 
     mlir_path = os.path.join(out_dir, "exported_predict.stablehlo.mlir")
-    with open(mlir_path, "w") as f:
-        f.write(exported.mlir_module())
+    atomic_write_bytes(mlir_path, exported.mlir_module().encode())
 
     # serialized default CompileOptionsProto for the C++ PJRT runner
     # (PJRT_Client_Compile requires one; building the proto in C++ would
     # drag in the whole schema)
     try:
         from jax._src.lib import xla_client as xc
-        with open(os.path.join(out_dir, "compile_options.pb"), "wb") as f:
-            f.write(xc.CompileOptions().SerializeAsString())
+        atomic_write_bytes(os.path.join(out_dir, "compile_options.pb"),
+                           xc.CompileOptions().SerializeAsString())
     except Exception as e:  # pragma: no cover - jaxlib internals may move
         print("warning: could not write compile_options.pb:", e)
 
-    with open(os.path.join(out_dir, "meta.json"), "w") as f:
-        json.dump({
-            "input_shape": [batch_size, imsize, imsize, 3],
-            "input_dtype": "uint8" if cfg.export_raw_input else "float32",
-            "outputs": ["boxes[B,N,4]", "classes[B,N]", "scores[B,N]",
-                        "valid[B,N]"],
-            "num_boxes": cfg.num_stack * cfg.topk,
-            "imsize": imsize,
-            "num_cls": cfg.num_cls,
-            "conf_th": cfg.conf_th,
-            "nms": cfg.nms,
-            "nms_th": cfg.nms_th,
-            "pretrained": cfg.pretrained,
-            # raw_input: artifact expects [0, 255] pixels (normalization
-            # baked in); else pre-normalized floats
-            "raw_input": bool(cfg.export_raw_input),
-        }, f, indent=2)
+    save_json(os.path.join(out_dir, "meta.json"), {
+        "input_shape": [batch_size, imsize, imsize, 3],
+        "input_dtype": "uint8" if cfg.export_raw_input else "float32",
+        "outputs": ["boxes[B,N,4]", "classes[B,N]", "scores[B,N]",
+                    "valid[B,N]"],
+        "num_boxes": cfg.num_stack * cfg.topk,
+        "imsize": imsize,
+        "num_cls": cfg.num_cls,
+        "conf_th": cfg.conf_th,
+        "nms": cfg.nms,
+        "nms_th": cfg.nms_th,
+        "pretrained": cfg.pretrained,
+        # raw_input: artifact expects [0, 255] pixels (normalization
+        # baked in); else pre-normalized floats
+        "raw_input": bool(cfg.export_raw_input),
+    }, indent=2)
     return bin_path, mlir_path
 
 

@@ -127,10 +127,12 @@ def write_detection_txt(out_dir: str, image_id: str, boxes, labels, scores) -> s
     (≡ ref evaluate.py:46-54)."""
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, image_id + ".txt")
-    with open(path, "w") as f:
-        for b, l, s in zip(boxes, labels, scores):
-            f.write("%d %f %f %f %f %f\n"
-                    % (int(l), float(s), b[0], b[1], b[2], b[3]))
+    from .utils import atomic_write_bytes
+    lines = "".join("%d %f %f %f %f %f\n"
+                    % (int(l), float(s), b[0], b[1], b[2], b[3])
+                    for b, l, s in zip(boxes, labels, scores))
+    # atomic: the external mAP tooling consumes whatever txt files exist
+    atomic_write_bytes(path, lines.encode())
     return path
 
 

@@ -1,8 +1,8 @@
 """ctypes binding for the native C++ GT encoder (cpp/hostops/encode.cc).
 
 The TPU-native framework's answer to the reference's native input path
-(imgaug's C-accelerated numpy + torch DataLoader worker processes,
-SURVEY.md §2.2): the per-box Gaussian splat runs as tight C loops over each
+(imgaug's C-accelerated numpy + torch DataLoader worker processes, ref
+data.py:127-161 + train.py:39-44, SURVEY.md §2.2): the per-box Gaussian splat runs as tight C loops over each
 box's support window — O(sum window areas) instead of the vectorized numpy
 broadcast's O(N*H*W) — keeping host-side collate off the critical path of
 short TPU steps.

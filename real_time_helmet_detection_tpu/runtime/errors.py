@@ -1,5 +1,8 @@
 """Transient-vs-permanent failure classification, shared process-wide.
 
+The reference has no failure classification (SURVEY.md §5; its only
+recovery is the manual restart of ref train.py:190-199).
+
 One definition used by three layers so they cannot drift:
 
 * `train.py --auto-resume` (in-process recovery) classifies the caught

@@ -1,5 +1,8 @@
 """Liveness signals: in-process stall warnings + cross-process heartbeats.
 
+The reference has no liveness detection (SURVEY.md §5 — a wedged run just
+sits there); both views here are new capability.
+
 Two views of the same contract:
 
 * `HangWatchdog` (moved here from train.py, re-exported there) watches the
@@ -36,9 +39,13 @@ STATUS_ENV = "TPU_QUEUE_STATUS"
 
 
 def _atomic_write_text(path: str, text: str) -> None:
-    """tmp + os.replace so a reader (or a crash) never sees a torn file."""
+    """tmp + os.replace so a reader (or a crash) never sees a torn file.
+
+    A stdlib-only twin of utils.atomic_write_bytes: runtime/ must stay
+    importable without numpy/PIL (supervisor processes never build the
+    ML stack), so it cannot import utils."""
     tmp = "%s.tmp.%d" % (path, os.getpid())
-    with open(tmp, "w") as f:
+    with open(tmp, "w") as f:  # graftlint: off=raw-artifact-write
         f.write(text)
     os.replace(tmp, path)
 

@@ -1,5 +1,8 @@
 """Persistent job spool: an append-only, fsynced JSON-lines journal.
 
+The reference has no job persistence (SURVEY.md §5 — a killed run loses
+everything but its last checkpoint, ref train.py:76-82).
+
 Why a journal and not a state file: the supervisor must survive `kill -9`
 BETWEEN any two state transitions with zero lost jobs (r2/r3 lost whole
 measurement campaigns to exactly this class of failure). An append-only

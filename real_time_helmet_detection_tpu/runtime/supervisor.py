@@ -1,5 +1,8 @@
 """Crash-restartable TPU job supervisor (ISSUE 3 tentpole).
 
+The reference has no supervision layer (SURVEY.md §5; its only recovery
+is a manual restart with --model-load, ref train.py:190-199).
+
 Owns every on-chip run: a persistent spool of jobs (runtime/spool.py), a
 relay/claim triage probe that classifies the three known failure modes
 BEFORE spending anything, a heartbeat + hang-kill-salvage contract for

@@ -601,6 +601,19 @@ def load_checkpoint(path: str, state: TrainState):
         batch_stats=restored["batch_stats"],
         opt_state=restored["opt_state"],
         ema_params=ema)
+    if jax.default_backend() == "cpu":
+        # XLA:CPU only: the restored state feeds straight into the
+        # DONATING train step, and donating orbax-restored buffers
+        # (tensorstore-backed host allocations XLA:CPU's allocator does
+        # not own) corrupts the glibc heap — reproduced at HEAD as the
+        # slow-tier test_auto_resume SIGABRT/SIGSEGV in the first
+        # post-recovery loss fetch ("malloc_consolidate(): invalid chunk
+        # size" when run outside pytest's capture); one jitted deep copy
+        # into XLA-owned buffers fixes the full e2e run. TPU restores are
+        # PJRT-allocated (donation is the normal, on-chip-proven path)
+        # and skip the copy — it would transiently double the state's
+        # HBM footprint.
+        st = jax.jit(lambda t: jax.tree.map(jnp.copy, t))(st)
     return st, int(raw_ckpt["epoch"]), _read_loss_log(path)
 
 
@@ -714,6 +727,8 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
         # leaf per bucket — rivaling the compile stall being hidden.
         chief = jax.process_index() == 0
         sacrificial = jax.jit(lambda s: jax.tree.map(jnp.copy, s))(state)
+        # timing here is the COMPILE stall being hidden, not device work —
+        # the one legitimate per-call wall-clock: graftlint: off=per-call-timing
         for target in sizes:
             t0 = time.time()
             sacrificial, _ = call_bucket(sacrificial, target)
@@ -812,6 +827,9 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
                 epoch_base_step: int = 0, watchdog=None,
                 injector: Optional[FaultInjector] = None) -> TrainState:
     """One epoch of the hot loop (≡ ref train.py:86-162 `train_step`)."""
+    # segment meters are host-visible averages made honest by the
+    # periodic flush barrier (see `pending` below), not per-call device
+    # timing — bench.py owns that: graftlint: off=per-call-timing
     meters = {k: AverageMeter() for k in ("data", "step")}
     loader.set_epoch(epoch)
     profiling = False

@@ -1,5 +1,9 @@
 """Weak-scaling harness: train-step throughput vs device count.
 
+The reference has no scaling measurement at all — its DDP launcher (ref
+train.py:23-45) scales but nothing records how well; this harness is the
+missing instrument.
+
 BASELINE.md demands >= 95% weak-scaling efficiency 1 -> 32 chips at 512^2.
 This harness measures it: for each device count N it runs the sharded train
 step on an N-device ("data") mesh with a FIXED per-chip batch (weak
@@ -163,8 +167,13 @@ def main() -> None:
         print("[scaling] skipping n=%d: not divisible by --spatial %d"
               % (n, args.spatial), file=sys.stderr, flush=True)
 
+    # supervised-job contract (scripts/tpu_queue.py): beat per device
+    # count — each child run is the natural progress unit
+    from real_time_helmet_detection_tpu.runtime import maybe_job_heartbeat
+    hb = maybe_job_heartbeat()
     results = []
     for n in counts:
+        hb.beat("scaling n=%d" % n)
         env = dict(os.environ)
         use_cpu = not on_tpu or n > n_real
         if use_cpu:
@@ -265,4 +274,5 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    from real_time_helmet_detection_tpu.runtime import run_as_job
+    run_as_job(main)  # status file + 0/75/1 exit contract (runtime/)

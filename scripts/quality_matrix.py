@@ -1,5 +1,9 @@
 """Quality-lever matrix on the hard 'scenes' fixture (round-3 verdict #3).
 
+Scores each lever with the same train->eval->mAP loop the reference runs
+by hand (ref train.py:86-162 + evaluate.py:15-97); the matrix harness
+itself has no reference analogue.
+
 Round 2 left the framework's quality levers built but unmeasured: the
 saturated blocks fixture (mAP 0.96-0.98) could not show a delta for
 num_stack=2, EMA eval, multiscale training, or soft-NMS. This script
@@ -45,7 +49,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import graft_round  # noqa: E402 — one shared round default
 from real_time_helmet_detection_tpu.runtime import \
     maybe_job_heartbeat  # noqa: E402
-from real_time_helmet_detection_tpu.utils import save_json  # noqa: E402
+from real_time_helmet_detection_tpu.utils import (  # noqa: E402
+    atomic_write_bytes, save_json)
 
 OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "artifacts",
@@ -202,8 +207,8 @@ def main() -> None:
         t0 = time.time()
         train(cfg)
         wall = time.time() - t0
-        with open(marker, "w") as f:
-            f.write("wall_s=%.1f\n" % wall)
+        # atomic: a truncated marker would read as "training complete"
+        atomic_write_bytes(marker, ("wall_s=%.1f\n" % wall).encode())
         log("training %s done in %.0fs" % (save, wall))
         return wall
 

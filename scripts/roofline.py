@@ -1,5 +1,8 @@
 """Per-fusion roofline attribution for the train step (ISSUE 2 tentpole).
 
+The reference has no performance attribution at all (SURVEY.md §5; its
+timing stops at the per-segment meters of ref train.py:92-140).
+
 bench.py's `mfu_train` says WHAT fraction of peak the step achieves;
 nothing said WHERE the rest goes. This tool grows scripts/trace_summary.py
 into a roofline attributor: it compiles the production scanned train step
@@ -57,6 +60,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import (DEFAULT_HBM, DEFAULT_PEAK, HBM_GBPS, PEAK_BF16,
                    acquire_backend, bytes_of, flops_of, graft_round, log)
+from real_time_helmet_detection_tpu.runtime import (maybe_job_heartbeat,
+                                                    run_as_job)
 
 SCHEMA = "roofline-v1"
 
@@ -465,7 +470,12 @@ def main() -> None:
     log("backend: %s (%s); classifying against %.0f TFLOP/s / %.0f GB/s"
         % (device_kind, platform, peak / 1e12, hbm / 1e9))
 
+    # supervised-job contract (scripts/tpu_queue.py): beat at the slow
+    # phase boundaries — first compile on a remote transport is minutes
+    hb = maybe_job_heartbeat()
+    hb.beat("backend up (%s)" % platform)
     compiled, state, arrs, remake = build_step(jax, args, args.loss_kernel)
+    hb.beat("step compiled")
     total_flops, total_bytes_ca = flops_of(compiled), bytes_of(compiled)
     comps, fusion_bodies, appliers = parse_hlo(compiled.as_text())
     rows = attribute(comps, fusion_bodies, appliers)
@@ -575,4 +585,4 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    run_as_job(main)  # status file + 0/75/1 exit contract (runtime/)

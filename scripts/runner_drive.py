@@ -92,8 +92,8 @@ def render_image(path: str) -> "tuple":
         make_synthetic_voc(root, num_train=1, num_test=1,
                            imsize=(IMSIZE, IMSIZE), max_objects=8, seed=7,
                            style="scenes")
-        with open(marker, "w") as f:
-            f.write("ok")
+        from real_time_helmet_detection_tpu.utils import atomic_write_bytes
+        atomic_write_bytes(marker, b"ok")  # atomic completion marker
     jpg_dir = os.path.join(root, "JPEGImages")
     jpg = os.path.join(jpg_dir, sorted(os.listdir(jpg_dir))[-1])
     arr = np.asarray(Image.open(jpg).convert("RGB"), dtype=np.uint8)

@@ -1,5 +1,8 @@
 """TPU job queue CLI — the required way to run on-chip jobs (CLAUDE.md).
 
+The reference has no job supervision of any kind (SURVEY.md §5; its only
+recovery is a manual restart, ref train.py:190-199).
+
 Front-end to the crash-restartable supervisor in
 `real_time_helmet_detection_tpu/runtime/` (spool + triage + heartbeat
 kill-salvage; see that package and docs/ARCHITECTURE.md "Failure domains

@@ -1,5 +1,9 @@
 """Summarize a jax.profiler device trace: top ops by total device time.
 
+The reference has no profiling tooling (SURVEY.md §5 — its timing is the
+per-segment AverageMeters of ref train.py:92-140); this is the trace-side
+instrument.
+
 Companion to scripts/mfu_breakdown.py's trace capture (round-3 verdict #2:
 commit the breakdown of where the non-MXU time goes). Parses the Chrome
 trace-event JSON (`*.trace.json.gz`) that jax.profiler writes under

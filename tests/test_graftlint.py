@@ -1,0 +1,279 @@
+"""graftlint (ISSUE 4): per-rule positive/negative fixtures + the
+repo-wide ratchet gate.
+
+The reference has no static analysis at all (its only check is the manual
+module self-test, ref /root/reference/hourglass.py:241-256); this suite
+pins the auditor that replaces convention-by-memory: every AST rule class
+and every trace rule class must fire on a seeded violation and stay
+silent on its clean twin, and the WHOLE repo at HEAD must lint clean
+against the committed analysis/baseline.json.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from real_time_helmet_detection_tpu.analysis import (  # noqa: E402
+    Finding, diff_baseline, load_baseline)
+from real_time_helmet_detection_tpu.analysis import ast_rules  # noqa: E402
+from real_time_helmet_detection_tpu.analysis import trace_audit  # noqa: E402
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# AST rule classes: positive + negative fixture each
+
+
+AST_CASES = [
+    # (rule, path-to-lint-under, bad source, good source)
+    ("ast/per-call-timing", "scripts/x.py",
+     "import time, jax\n"
+     "def f(c, x):\n"
+     "    t0 = time.time()\n"
+     "    r = c(x)\n"
+     "    jax.block_until_ready(r)\n"
+     "    return time.time() - t0\n",
+     "import time, jax\n"
+     "def f(c, x):\n"
+     "    jax.block_until_ready(c(x))\n"
+     "def g():\n"
+     "    t0 = time.time()\n"
+     "    return time.time() - t0\n"),
+    ("ast/queue-bypass", "scripts/x.py",
+     "from bench import acquire_backend\n"
+     "jax, devs = acquire_backend()\n",
+     "from bench import acquire_backend\n"
+     "from real_time_helmet_detection_tpu.runtime import run_as_job\n"
+     "def main():\n"
+     "    jax, devs = acquire_backend()\n"
+     "run_as_job(main)\n"),
+    ("ast/env-platform-write", "scripts/x.py",
+     "import os\n"
+     "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n",
+     "import os\n"
+     "os.environ.setdefault('XLA_FLAGS', '')\n"),
+    ("ast/raw-artifact-write", "scripts/x.py",
+     "def w(path, data):\n"
+     "    with open(path, mode='wb') as f:\n"
+     "        f.write(data)\n",
+     "def r(path):\n"
+     "    with open(path, 'rb') as f:\n"
+     "        return f.read()\n"),
+    ("ast/device-get-in-loop", "scripts/x.py",
+     "import jax\n"
+     "def run(step, s, batches):\n"
+     "    while batches:\n"
+     "        s, loss = step(s, batches.pop())\n"
+     "        jax.device_get(loss)\n",
+     "import jax\n"
+     "def run(step, s, batches):\n"
+     "    out = [step(s, b)[1] for b in batches]\n"
+     "    return jax.device_get(out)\n"),
+    ("ast/missing-ref-citation", "scripts/x.py",
+     '"""Module with no provenance statement whatsoever."""\nX = 1\n',
+     '"""Module citing ref evaluate.py:15 properly."""\nX = 1\n'),
+]
+
+
+@pytest.mark.parametrize("rule,path,bad,good", AST_CASES,
+                         ids=[c[0] for c in AST_CASES])
+def test_ast_rule_fires_and_stays_silent(rule, path, bad, good):
+    assert rule in rules_of(ast_rules.lint_source(bad, path))
+    assert rule not in rules_of(ast_rules.lint_source(good, path))
+
+
+def test_queue_bypass_scoped_to_chip_scripts():
+    """A library module may probe jax.devices() without the job contract —
+    the rule is about scripts/ (+ bench/scaling) only."""
+    src = "import jax\nd = jax.devices()\n"
+    assert "ast/queue-bypass" in rules_of(
+        ast_rules.lint_source(src, "scripts/x.py"))
+    assert "ast/queue-bypass" not in rules_of(
+        ast_rules.lint_source(src, "real_time_helmet_detection_tpu/x.py"))
+
+
+def test_inline_suppression_and_syntax_error():
+    bad = ("def w(p, d):\n"
+           "    with open(p, 'w') as f:  # graftlint: off=raw-artifact-write\n"
+           "        f.write(d)\n")
+    assert "ast/raw-artifact-write" not in rules_of(
+        ast_rules.lint_source(bad, "scripts/x.py"))
+    assert "ast/syntax-error" in rules_of(
+        ast_rules.lint_source("def broken(:\n", "scripts/x.py"))
+
+
+def test_timing_allowlist_covers_bench_harness():
+    """bench.timed_fetch IS the sanctioned implementation; the rule must
+    not flag the tool it tells people to use."""
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    assert "ast/per-call-timing" not in rules_of(
+        ast_rules.lint_source(src, "bench.py"))
+
+
+# ---------------------------------------------------------------------------
+# trace rule classes: positive + negative fixture each
+
+
+def test_trace_failure_on_boolean_filtering():
+    import jax.numpy as jnp
+    x = np.ones((4, 4), np.float32)
+    bad = trace_audit.audit_entry(lambda v: v[v > 0], (x,), "fix")
+    assert "trace/trace-failure" in rules_of(bad)
+    good = trace_audit.audit_entry(lambda v: jnp.where(v > 0, v, 0.0),
+                                   (x,), "fix")
+    assert not good
+
+
+def test_f64_leak_detected():
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+    x = np.ones((4,), np.float32)
+    with enable_x64():
+        bad = trace_audit.audit_entry(
+            lambda v: jnp.asarray(v, jnp.float64) * 2.0, (x,), "fix",
+            lower=False)
+    assert "trace/f64" in rules_of(bad)
+    good = trace_audit.audit_entry(lambda v: v * 2.0, (x,), "fix",
+                                   lower=False)
+    assert "trace/f64" not in rules_of(good)
+
+
+def test_host_callback_detected_through_scan():
+    """The walk must reach primitives nested in sub-jaxprs (scan body)."""
+    import jax
+    import jax.numpy as jnp
+
+    def bad(v):
+        def body(c, _):
+            jax.debug.print("c={}", c[0])
+            return c + 1.0, ()
+        out, _ = jax.lax.scan(body, v, None, length=2)
+        return out
+
+    x = np.ones((4,), np.float32)
+    assert "trace/host-callback" in rules_of(
+        trace_audit.audit_entry(bad, (x,), "fix", lower=False))
+
+    def good(v):
+        out, _ = jax.lax.scan(lambda c, _: (c + 1.0, ()), v, None, length=2)
+        return jnp.sum(out)
+
+    assert "trace/host-callback" not in rules_of(
+        trace_audit.audit_entry(good, (x,), "fix", lower=False))
+
+
+def test_donation_rule_and_donation_ok():
+    import jax.numpy as jnp
+    x = np.ones((4, 4), np.float32)
+    bad = lambda v: jnp.sum(v)            # noqa: E731 — no aliasing target
+    good = lambda v: (v + 1.0, jnp.sum(v))  # noqa: E731
+    assert "trace/donation" in rules_of(
+        trace_audit.audit_entry(bad, (x,), "fix", donate_argnums=(0,),
+                                lower=False))
+    assert "trace/donation" not in rules_of(
+        trace_audit.audit_entry(good, (x,), "fix", donate_argnums=(0,),
+                                lower=False))
+    assert trace_audit.donation_ok(good, (0,), (x,))
+    assert not trace_audit.donation_ok(bad, (0,), (x,))
+
+
+def test_retrace_instability_detected():
+    import random
+    x = np.ones((4,), np.float32)
+    assert "trace/retrace-unstable" in rules_of(
+        trace_audit.audit_entry(lambda v: v + random.random(), (x,), "fix",
+                                lower=False))
+    assert "trace/retrace-unstable" not in rules_of(
+        trace_audit.audit_entry(lambda v: v + 1.0, (x,), "fix",
+                                lower=False))
+
+
+def test_dynamic_shape_detected_in_stablehlo():
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+    b = jax_export.symbolic_shape("b")[0]
+    spec = jax.ShapeDtypeStruct((b, 4), jnp.float32)
+    assert "trace/dynamic-shape" in rules_of(
+        trace_audit.stablehlo_findings(lambda v: v * 2.0, (spec,), "fix"))
+    x = np.ones((4, 4), np.float32)
+    assert not trace_audit.stablehlo_findings(lambda v: v * 2.0, (x,),
+                                              "fix")
+
+
+def test_scanned_train_fn_donation_contract():
+    """The production contract bench.py's `donation_ok` reports: the
+    scanned train fn returns the FULL final state, so the donated input
+    state aliases completely."""
+    train_n, args = trace_audit._tiny_train_parts("none")
+    assert trace_audit.donation_ok(train_n, (0,), args)
+    # and the scalar-only variant (the pre-PR1 bug shape) must NOT be ok
+    scalar_only = lambda *a: train_n(*a)[1]  # noqa: E731
+    assert not trace_audit.donation_ok(scalar_only, (0,), args)
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet mechanics
+
+
+def test_baseline_diff_ratchet():
+    f1 = Finding(rule="r", path="a.py", message="m", context="f")
+    f2 = Finding(rule="r", path="b.py", message="m", context="g")
+    base = {f1.key: "justified"}
+    d = diff_baseline([f1, f2], base)
+    assert [f.key for f in d["new"]] == [f2.key]
+    assert [f.key for f in d["baselined"]] == [f1.key]
+    assert d["stale"] == []
+    d2 = diff_baseline([], base)
+    assert d2["stale"] == [f1.key]
+
+
+# ---------------------------------------------------------------------------
+# the repo-wide gates (the CI teeth)
+
+
+def test_repo_ast_layer_clean_vs_baseline():
+    findings = ast_rules.lint_repo(REPO)
+    d = diff_baseline(findings, load_baseline())
+    assert not d["new"], "new AST findings (fix or baseline with a " \
+        "justification):\n" + "\n".join(
+            "%s %s:%d %s" % (f.rule, f.path, f.line, f.message)
+            for f in d["new"])
+
+
+def test_repo_trace_audit_clean_vs_baseline():
+    """Every public entry point traces clean (fixed shapes, no f64, no
+    callbacks, donation aliasable, deterministic retrace). Jaxpr-level
+    only: the StableHLO lowering pass adds minutes of CPU for no extra
+    rule the entry points could realistically trip (dynamic dims cannot
+    appear without symbolic shapes, which none of the entries use)."""
+    findings = trace_audit.audit_repo_entry_points(lower=False)
+    d = diff_baseline(findings, load_baseline())
+    assert not d["new"], "new trace findings:\n" + "\n".join(
+        "%s %s %s" % (f.rule, f.context, f.message) for f in d["new"])
+
+
+def test_cli_selfcheck_subprocess():
+    """`graftlint --selfcheck` proves every rule fires on seeded fixtures
+    (mirrors tpu_queue.py --selfcheck), as a real subprocess, and keeps
+    the ONE-JSON-line stdout contract."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftlint.py"),
+         "--selfcheck"],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, "ONE JSON line expected, got: %r" % lines
+    rec = json.loads(lines[0])
+    assert rec["ok"] is True and rec["selfcheck"] is True
+    assert rec["failures"] == []

@@ -257,6 +257,17 @@ def test_checkpoint_roundtrip(tmp_path):
     # optimizer state NOT restored on the params-only path
     assert jax.tree.structure(evald.opt_state) == jax.tree.structure(fresh2.opt_state)
 
+    # Regression (the slow-tier test_auto_resume SIGABRT): a restored
+    # state goes straight into the DONATING train step on resume. Before
+    # load_checkpoint's XLA:CPU deep copy, donating the orbax-restored
+    # (tensorstore-backed) buffers corrupted the glibc heap —
+    # "malloc_consolidate(): invalid chunk size" at the next allocation.
+    # Two donating steps + a fetch exercise exactly that path.
+    stepped, losses2 = step(restored, *batch)
+    stepped, losses3 = step(stepped, *batch)
+    assert np.isfinite(float(jax.device_get(losses3["total"])))
+    assert int(jax.device_get(stepped.step)) == 3  # 1 saved + 2 resumed
+
 
 def test_eval_restore_ignores_optimizer_config(tmp_path):
     """Regression: a checkpoint trained with --sub-divisions 2 (MultiSteps
