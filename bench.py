@@ -79,9 +79,15 @@ DEFAULT_HBM = 819e9  # v5e
 # and runner_drive.py (they diverged in r5: mfu_breakdown defaulted to r05
 # while the rest stayed at r04, scattering same-round artifacts — ADVICE
 # r5 #3); bump it here when a new round starts, or override per-run with
-# $GRAFT_ROUND. r07 = the step-compression round (ISSUE 2); earlier rounds'
-# artifact dirs are committed history and must not be overwritten.
-GRAFT_ROUND_DEFAULT = "r07"
+# $GRAFT_ROUND. r08 = the int8 inference-compression round (ISSUE 5);
+# earlier rounds' artifact dirs are committed history and must not be
+# overwritten.
+GRAFT_ROUND_DEFAULT = "r08"
+
+# v5e int8 MXU peak (2x the bf16 peak — jax-ml scaling-book): the
+# denominator for int8-path MFU and the hardware case for --infer-dtype
+# int8 (ops/quant.py).
+PEAK_INT8_V5E = 3.94e14
 
 
 def graft_round() -> str:
@@ -231,7 +237,8 @@ def find_last_tpu_result(repo_root: str | None = None) -> dict | None:
     keep = ("metric", "value", "unit", "vs_baseline", "imsize", "batch",
             "latency_ms_b1", "train_img_per_sec_chip", "train_step_ms",
             "mfu_train", "mfu_fwd", "device_kind", "peak_pallas_us",
-            "peak_xla_us", "pallas_matches_xla")
+            "peak_xla_us", "pallas_matches_xla", "infer_dtype", "int8_fps",
+            "int8_vs_bf16")
     out.update({k: rec[k] for k in keep if k in rec})
     return out
 
@@ -384,6 +391,19 @@ def _bench(out: dict, hb) -> None:
     from real_time_helmet_detection_tpu.train import init_variables
 
     dtype = None if os.environ.get("BENCH_DTYPE") == "fp32" else jnp.bfloat16
+    # --infer-dtype int8 (or BENCH_INFER_DTYPE=int8 from a chain): ALSO
+    # measure the quantized predict path (ops/quant.py). The primary
+    # metric stays the float path so BENCH_rNN trajectories remain
+    # comparable; the int8 numbers ride along as int8_fps/int8_vs_bf16.
+    infer_dtype = os.environ.get("BENCH_INFER_DTYPE", "bf16")
+    if "--infer-dtype" in sys.argv:
+        i = sys.argv.index("--infer-dtype")
+        if i + 1 >= len(sys.argv):
+            raise SystemExit("--infer-dtype needs a value (bf16|int8)")
+        infer_dtype = sys.argv[i + 1]
+    if infer_dtype not in ("bf16", "int8"):
+        raise SystemExit("--infer-dtype must be bf16 or int8, got %r"
+                         % infer_dtype)
     cfg = Config(num_stack=1, hourglass_inch=128, num_cls=2, topk=100,
                  conf_th=0.0, nms_th=0.5, imsize=imsize)
     model = build_model(cfg, dtype=dtype)
@@ -393,6 +413,7 @@ def _bench(out: dict, hb) -> None:
         "vs_baseline": None, "platform": platform,
         "device_kind": device_kind,
         "dtype": "float32" if dtype is None else "bfloat16",
+        "infer_dtype": infer_dtype,
         "imsize": imsize, "batch": batch,
     })
 
@@ -411,7 +432,7 @@ def _bench(out: dict, hb) -> None:
     variables = {"params": params, "batch_stats": batch_stats}
     predict = make_predict_fn(model, cfg)
 
-    def make_predict_chain(n):
+    def make_predict_chain(pred, n):
         """N sequential predicts in ONE program; each iteration's input
         depends (negligibly: +score*1e-12) on the previous output so XLA
         cannot collapse or parallelize the chain.
@@ -426,7 +447,7 @@ def _bench(out: dict, hb) -> None:
         donated input (`chain_timed_fetch`)."""
         def prog(variables, images):
             def body(imgs, _):
-                det = predict(variables, imgs)
+                det = pred(variables, imgs)
                 eps = (jnp.tanh(jnp.sum(det.scores)) * 1e-12).astype(
                     imgs.dtype)
                 return imgs + eps, ()
@@ -438,7 +459,8 @@ def _bench(out: dict, hb) -> None:
     try:
         images = jnp.asarray(rng.standard_normal(
             (batch, imsize, imsize, 3)).astype(np.float32))
-        compiled = make_predict_chain(n_inf).lower(variables, images).compile()
+        compiled = make_predict_chain(predict, n_inf).lower(
+            variables, images).compile()
         chain_flops = flops_of(compiled)
         images, s = compiled(variables, images)  # warmup (donates images;
         np.asarray(s)  # the returned carry is the next call's input)
@@ -463,7 +485,7 @@ def _bench(out: dict, hb) -> None:
     try:
         img1 = jnp.asarray(rng.standard_normal(
             (1, imsize, imsize, 3)).astype(np.float32))
-        c1 = make_predict_chain(n_b1).lower(variables, img1).compile()
+        c1 = make_predict_chain(predict, n_b1).lower(variables, img1).compile()
         img1, s1 = c1(variables, img1)  # warmup (donates img1)
         np.asarray(s1)
         dt = chain_timed_fetch(c1, variables, img1, overhead)
@@ -472,6 +494,42 @@ def _bench(out: dict, hb) -> None:
     except Exception as e:  # noqa: BLE001
         log("latency bench failed: %r" % e)
     hb.beat("latency section done")
+
+    # --- int8 inference (--infer-dtype int8) ------------------------------
+    # The quantized predict chain (ops/quant.py: BN fold + per-channel
+    # int8 weights inside the program, calibrated activation scales closed
+    # over). Same chain/donation/timing methodology as the float section;
+    # the speedup ratio int8_vs_bf16 is the headline the v5e's 2x int8
+    # MXU peak predicts for a conv-bound program.
+    if infer_dtype == "int8":
+        try:
+            import dataclasses
+
+            from real_time_helmet_detection_tpu.ops.quant import (
+                calibrate_scales, synthetic_calibration_batches)
+            icfg = dataclasses.replace(cfg, infer_dtype="int8")
+            scales = calibrate_scales(
+                icfg, variables,
+                synthetic_calibration_batches(batch, imsize, n=2),
+                dtype=dtype)
+            ipredict = make_predict_fn(model, icfg, quant_scales=scales)
+            imgs8 = jnp.asarray(rng.standard_normal(
+                (batch, imsize, imsize, 3)).astype(np.float32))
+            ic = make_predict_chain(ipredict, n_inf).lower(
+                variables, imgs8).compile()
+            imgs8, s8 = ic(variables, imgs8)  # warmup (donates imgs8)
+            np.asarray(s8)
+            dt = chain_timed_fetch(ic, variables, imgs8, overhead)
+            int8_fps = batch * n_inf / dt
+            out["int8_fps"] = round(int8_fps, 2)
+            if out.get("value"):
+                out["int8_vs_bf16"] = round(int8_fps / out["value"], 3)
+            log("int8 inference: %.1f img/s (%.3f ms/batch-%d, %sx bf16)"
+                % (int8_fps, dt / n_inf * 1e3, batch,
+                   out.get("int8_vs_bf16", "?")))
+        except Exception as e:  # noqa: BLE001
+            log("int8 bench failed: %r" % e)
+        hb.beat("int8 section done")
 
     # --- train-step throughput + MFU(train) -------------------------------
     try:

@@ -286,6 +286,35 @@ def _tiny_predict_parts(normalize: Optional[str] = None):
     return predict, variables, images
 
 
+def _tiny_predict_int8_parts():
+    """The quantized predict entry (ISSUE 5): BN-folded int8 twin over
+    the SAME tiny checkpoint pytree, scales from a 2-batch synthetic
+    calibration pass — the exact program `--infer-dtype int8`
+    eval/export/bench run, at audit shapes."""
+    import jax
+    import numpy as np
+
+    from ..config import Config
+    from ..models import build_model
+    from ..ops.quant import calibrate_scales, synthetic_calibration_batches
+    from ..predict import make_predict_fn
+    from ..train import init_variables
+
+    cfg = Config(topk=16, conf_th=0.0, nms_th=0.5, infer_dtype="int8",
+                 **_TINY)
+    model = build_model(cfg)
+    params, batch_stats = init_variables(model, jax.random.key(0),
+                                         _TINY["imsize"])
+    variables = {"params": params, "batch_stats": batch_stats}
+    scales = calibrate_scales(
+        cfg, variables,
+        synthetic_calibration_batches(_BATCH, _TINY["imsize"], n=2))
+    predict = make_predict_fn(model, cfg, quant_scales=scales)
+    images = np.zeros((_BATCH, _TINY["imsize"], _TINY["imsize"], 3),
+                      np.float32)
+    return predict, variables, images
+
+
 def _predict_chain(predict, n: int = 2):
     """bench.py's donating predict-chain contract (make_predict_chain):
     images donated, final carry returned as the aliasing target."""
@@ -309,7 +338,9 @@ def audit_repo_entry_points(lower: bool = True) -> List[Finding]:
     Entries mirror the production surfaces: the scanned train step
     (bench.py/scaling.py's timed program) across the tpu_sweep
     step-grid remat policies, the jitted predict fn (eval), the donating
-    predict chain (bench), the raw-uint8-wire predict (eval driver /
+    predict chain (bench), the quantized int8 predict + its donating
+    chain (--infer-dtype int8, ops/quant.py — the program tpu_sweep's
+    int8 section times), the raw-uint8-wire predict (eval driver /
     export --export-raw-input), and the export fn (the C++ runner's
     artifact)."""
     findings: List[Finding] = []
@@ -364,6 +395,28 @@ def audit_repo_entry_points(lower: bool = True) -> List[Finding]:
     except Exception as e:  # noqa: BLE001
         findings.append(Finding(
             rule="trace/trace-failure", path="<predict>", context="predict",
+            message="entry construction failed: %s: %s"
+                    % (type(e).__name__,
+                       (str(e).splitlines() or ["?"])[0][:200])))
+
+    try:
+        # the quantized predict (--infer-dtype int8, ops/quant.py): the
+        # BN fold + weight quantization run inside the program, so the
+        # int8 entry has its own trace surface to keep honest — plus the
+        # donating bench chain over it (the exact program tpu_sweep's
+        # int8 section times)
+        predict_q, variables_q, images_q = _tiny_predict_int8_parts()
+        findings += audit_entry(
+            lambda v, im: predict_q(v, im), (variables_q, images_q),
+            "predict_int8", lower=lower)
+        chain_q = _predict_chain(predict_q)
+        findings += audit_entry(chain_q, (variables_q, images_q),
+                                "predict_int8_chain", donate_argnums=(1,),
+                                lower=lower)
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            rule="trace/trace-failure", path="<predict_int8>",
+            context="predict_int8",
             message="entry construction failed: %s: %s"
                     % (type(e).__name__,
                        (str(e).splitlines() or ["?"])[0][:200])))

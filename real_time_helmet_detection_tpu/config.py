@@ -96,8 +96,22 @@ class Config:
     nms_th: float = 0.5
     pool_size: int = 3            # peak-test window (3x3, as the reference)
     model_load: Optional[str] = None
-    nms: str = "nms"              # nms | soft-nms
+    nms: str = "nms"              # nms | soft-nms | maxpool (PSRR-style
+    # parallel maxpool suppression, ops/nms.py — approximate, no serial
+    # greedy chain)
     fontsize: int = 10
+    infer_dtype: str = "bf16"     # predict/eval/export numeric path:
+    # "bf16" = the existing float graph (actual compute dtype follows
+    # --amp: bf16 when set, fp32 otherwise); "int8" = BN-folded
+    # post-training-quantized convs (ops/quant.py) — eval/export ONLY,
+    # training always stays float. Gated on mAP parity, not just speed
+    # (docs/ARCHITECTURE.md "Inference compression").
+    quant_scales: Optional[str] = None  # path to a saved activation-scales
+    # artifact (ops.quant.save_scales); unset = calibrate on the fly from
+    # the first --calib-batches eval batches and save one
+    calib_batches: int = 4        # calibration batches when no --quant-scales
+    calib_percentile: float = 100.0  # activation clip statistic: 100 =
+    # abs-max, <100 = that upper percentile of |x| (outlier-robust)
 
     # augmentation
     crop_percent: List[float] = field(default_factory=lambda: [0.0, 0.1])
@@ -219,6 +233,15 @@ class Config:
         if self.loss_kernel not in ("auto", "fused", "xla"):
             raise ValueError("--loss-kernel must be one of auto|fused|xla, "
                              "got %r" % (self.loss_kernel,))
+        if self.infer_dtype not in ("bf16", "int8"):
+            raise ValueError("--infer-dtype must be 'bf16' or 'int8', "
+                             "got %r" % (self.infer_dtype,))
+        if self.calib_batches < 1:
+            raise ValueError("--calib-batches must be >= 1, got %d"
+                             % self.calib_batches)
+        if not 0.0 < self.calib_percentile <= 100.0:
+            raise ValueError("--calib-percentile must be in (0, 100], "
+                             "got %r" % (self.calib_percentile,))
         if self.loader not in ("thread", "process"):
             raise ValueError("--loader must be 'thread' or 'process', got %r"
                              % self.loader)

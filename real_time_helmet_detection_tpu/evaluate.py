@@ -65,6 +65,58 @@ def _origin_size(voc_dict: Dict) -> Tuple[int, int]:
     return int(size["width"]), int(size["height"])
 
 
+def _eval_quant_scales(cfg: Config, variables, loader, chief: bool = True):
+    """Activation scales for `--infer-dtype int8`: the saved artifact when
+    `--quant-scales` names one, else an on-the-fly calibration pass over
+    the first `--calib-batches` batches of the (deterministic, raw-uint8)
+    eval loader — each batch is ONE jitted dispatch fetching only
+    per-layer scalars (ops/quant.py). The freshly calibrated scales are
+    persisted atomically under `<save_path>/calibration/` so the run is
+    reproducible and export can pin its hash."""
+    from .ops.quant import calibrate_scales, load_scales, save_scales
+
+    if cfg.quant_scales:
+        print("%s: int8 scales <- %s" % (timestamp(), cfg.quant_scales),
+              flush=True)
+        return load_scales(cfg.quant_scales)
+
+    def batches():
+        n = 0
+        it = iter(loader)
+        try:
+            for batch in it:
+                images = batch.image
+                if images.shape[0] < cfg.batch_size:
+                    # pad to the steady-state shape: one calibration
+                    # program, no second XLA compile on an odd tail batch
+                    pad = cfg.batch_size - images.shape[0]
+                    images = np.concatenate(
+                        [images,
+                         np.zeros((pad,) + images.shape[1:], images.dtype)])
+                yield images
+                n += 1
+                if n >= cfg.calib_batches:
+                    break
+        finally:
+            if hasattr(it, "close"):
+                it.close()  # reap the loader's producer thread
+
+    dtype = jnp.bfloat16 if cfg.amp else None
+    scales = calibrate_scales(cfg, variables, batches(), dtype=dtype,
+                              normalize=cfg.pretrained,
+                              percentile=cfg.calib_percentile)
+    path = os.path.join(cfg.save_path, "calibration", "quant_scales.json")
+    if chief:
+        digest = save_scales(path, scales, meta={
+            "calib_batches": cfg.calib_batches,
+            "calib_percentile": cfg.calib_percentile,
+            "model_load": cfg.model_load})
+        print("%s: int8 calibration (%d batches, p%.5g) -> %s (sha256 %s)"
+              % (timestamp(), cfg.calib_batches, cfg.calib_percentile,
+                 path, digest[:12]), flush=True)
+    return scales
+
+
 def evaluate(cfg: Config) -> Dict:
     """Full test-split evaluation (≡ ref evaluate.py:15-97) + in-repo mAP.
 
@@ -105,11 +157,6 @@ def evaluate(cfg: Config) -> Dict:
         # work happens only at the final allgather
         print("%s: multi-host eval rank %d/%d (split sharded by rank)"
               % (timestamp(), rank, world), flush=True)
-    # raw wire: images ship as uint8 canvases and are normalized on-device
-    # inside the jitted predict program (see make_predict_fn)
-    predict = make_predict_fn(model, cfg, normalize=cfg.pretrained,
-                              mesh=mesh)
-
     dataset, augmentor = load_dataset(cfg)
     loader_cls = BatchLoader
     if cfg.loader == "process":
@@ -124,6 +171,19 @@ def evaluate(cfg: Config) -> Dict:
                         max_boxes=cfg.max_boxes, shuffle=False,
                         drop_last=False, num_workers=cfg.num_workers,
                         rank=rank, world_size=world, raw=True)
+
+    # raw wire: images ship as uint8 canvases and are normalized on-device
+    # inside the jitted predict program (see make_predict_fn).
+    # --infer-dtype int8 additionally needs the calibrated activation
+    # scales: a saved artifact (--quant-scales), or an on-the-fly
+    # calibration pass over the first --calib-batches eval batches (one
+    # jitted dispatch per batch fetching only per-layer scalars).
+    quant_scales = None
+    if cfg.infer_dtype == "int8":
+        quant_scales = _eval_quant_scales(cfg, variables, loader,
+                                          chief=rank == 0)
+    predict = make_predict_fn(model, cfg, normalize=cfg.pretrained,
+                              mesh=mesh, quant_scales=quant_scales)
 
     txt_dir = os.path.join(cfg.save_path, "results", "txt")
     results: Dict[str, Dict] = {}
@@ -382,10 +442,20 @@ def demo(cfg: Config) -> Dict:
     """Single-image demo (≡ ref evaluate.py:245-290). `cfg.data` is the
     image path. Saves the overlay as `image.png` in save_path."""
     model, variables = load_eval_state(cfg)
-    predict = make_predict_fn(model, cfg)
 
     imsize = cfg.imsize or 512
     img, img_pil, origin_size = imload(cfg.data, cfg.pretrained, imsize)
+    quant_scales = None
+    if cfg.infer_dtype == "int8":
+        # one-image demo: the saved artifact when given, else
+        # self-calibrate on the demo image (the normalized-input wire)
+        from .ops.quant import calibrate_scales, load_scales
+        quant_scales = (load_scales(cfg.quant_scales) if cfg.quant_scales
+                        else calibrate_scales(
+                            cfg, variables, [img],
+                            dtype=jnp.bfloat16 if cfg.amp else None,
+                            percentile=cfg.calib_percentile))
+    predict = make_predict_fn(model, cfg, quant_scales=quant_scales)
     dets = jax.device_get(predict(variables, jnp.asarray(img)))
 
     keep = dets.valid[0]

@@ -36,15 +36,19 @@ from .utils import atomic_write_bytes, save_json
 
 
 def build_export_fn(model, variables, cfg: Config,
-                    normalize: Optional[str] = None):
+                    normalize: Optional[str] = None, quant_scales=None):
     """Close the variables over the fused predict fn: images -> Detections
     as a flat tuple (boxes, classes, scores, valid).
 
     `normalize` bakes the input normalization INTO the artifact (see
     make_predict_fn): the deployment app then feeds raw [0, 255] pixels —
     a self-contained artifact, unlike the reference's TorchScript trace
-    whose normalization lives in the C++ app (ref PytorchToCpp)."""
-    predict = make_predict_fn(model, cfg, normalize=normalize)
+    whose normalization lives in the C++ app (ref PytorchToCpp).
+    `quant_scales` (with cfg.infer_dtype == "int8") bakes the BN-folded
+    int8-quantized network into the artifact instead — the serialized
+    StableHLO then carries int8 convolution bodies end to end."""
+    predict = make_predict_fn(model, cfg, normalize=normalize,
+                              quant_scales=quant_scales)
 
     def fn(images: jax.Array):
         d = predict(variables, images)
@@ -68,7 +72,45 @@ def export_predict(cfg: Config, out_dir: Optional[str] = None,
 
     model, variables = load_eval_state(cfg)
     normalize = cfg.pretrained if cfg.export_raw_input else None
-    fn = build_export_fn(model, variables, cfg, normalize=normalize)
+
+    # --infer-dtype int8: the exported program is the BN-folded quantized
+    # predict. Scales come from a saved calibration artifact
+    # (--quant-scales, the production path — calibrate on real data via
+    # `evaluate`), else from a synthetic calibration pass (smoke tests /
+    # fresh-init exports); either way the scales used are re-persisted
+    # next to the artifact and their hash pinned in meta.json so the
+    # served program is traceable to its calibration run.
+    quant_scales = None
+    scales_sha = None
+    scales_rel = None
+    if cfg.infer_dtype == "int8":
+        from .ops.quant import (calibrate_scales, load_scales, save_scales,
+                                synthetic_calibration_batches)
+        if cfg.quant_scales:
+            quant_scales = load_scales(cfg.quant_scales)
+        else:
+            print("warning: --infer-dtype int8 export without "
+                  "--quant-scales; calibrating on synthetic batches "
+                  "(smoke-quality scales — pass the eval-produced "
+                  "artifact for a served deployment)")
+            import jax.numpy as _jnp
+            quant_scales = calibrate_scales(
+                cfg, variables,
+                synthetic_calibration_batches(
+                    batch_size, imsize, n=cfg.calib_batches,
+                    raw=cfg.export_raw_input),
+                dtype=_jnp.bfloat16 if cfg.amp else None,
+                normalize=normalize,
+                percentile=cfg.calib_percentile)
+        scales_path = os.path.join(out_dir, "calibration",
+                                   "quant_scales.json")
+        scales_sha = save_scales(scales_path, quant_scales, meta={
+            "source": cfg.quant_scales or "synthetic",
+            "calib_percentile": cfg.calib_percentile})
+        scales_rel = os.path.relpath(scales_path, out_dir)
+
+    fn = build_export_fn(model, variables, cfg, normalize=normalize,
+                         quant_scales=quant_scales)
 
     # raw-input artifacts take uint8 pixels: 4x less wire traffic per
     # frame, with the cast + normalization baked into the program
@@ -114,6 +156,13 @@ def export_predict(cfg: Config, out_dir: Optional[str] = None,
         # raw_input: artifact expects [0, 255] pixels (normalization
         # baked in); else pre-normalized floats
         "raw_input": bool(cfg.export_raw_input),
+        # inference-compression provenance: which numeric path the
+        # artifact bakes in, and (int8) the sha256 + location of the
+        # exact activation-scales pytree it was built with — a served
+        # artifact is traceable to its calibration run
+        "infer_dtype": cfg.infer_dtype,
+        "quant_scales_sha256": scales_sha,
+        "quant_scales_path": scales_rel,
     }, indent=2)
     return bin_path, mlir_path
 

@@ -28,7 +28,15 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
+from ..ops.quant import quantize_activations, quantize_weights
+
 Dtype = Any
+
+# quantization modes of the inference-only model twin (ops/quant.py):
+# "off" = the ordinary float graph; "calibrate" = float graph that records
+# each quantized conv's input abs-max/percentile into the `quant`
+# collection; "int8" = int8 conv bodies consuming the calibrated scales.
+QUANT_MODES = ("off", "calibrate", "int8")
 
 
 def mish(x: jax.Array) -> jax.Array:
@@ -161,9 +169,85 @@ class StemConv(nn.Module):
         return y + bias.astype(dt)
 
 
+class QuantConv(nn.Module):
+    """Post-training-quantized conv body for the inference twin
+    (ops/quant.py; the reference serves fp32 through TorchScript and has
+    no quantized path, ref export.py:55).
+
+    Param tree is IDENTICAL to `nn.Conv(use_bias=True)` ('kernel' HWIO +
+    'bias'), so the BN-folded checkpoint pytree drops straight in under
+    the same `Conv_0` name. Two modes:
+
+    * `calibrate` — float conv, plus the input's abs-max (or upper
+      `calib_percentile` of |x|) recorded into the `quant` collection as
+      `act_scale`: ONE scalar per conv per dispatch, so a calibration
+      batch fetches only per-layer scalars (tunnel-friendly).
+    * `int8` — symmetric per-tensor activation + per-output-channel
+      weight quantization, int8 x int8 `lax.conv_general_dilated` with
+      `preferred_element_type=int32` (the v5e's 394 TOPS int8 MXU path,
+      2x bf16 peak), then one fused rescale `acc * (s_a * s_w)` + bias in
+      the compute dtype (bf16 under --amp). Weights quantize INSIDE the
+      program from the folded fp32 kernel — the artifact contract stays
+      "checkpoint pytree + scales pytree in".
+    """
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 1
+    mode: str = "int8"  # "calibrate" | "int8"
+    calib_percentile: float = 100.0
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        k = self.kernel_size
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (k, k, x.shape[-1], self.features))
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,))
+        dt = self.dtype or x.dtype
+        dn = ("NHWC", "HWIO", "NHWC")
+        pad = ((self.padding, self.padding), (self.padding, self.padding))
+        if self.mode == "calibrate":
+            ax = jnp.abs(x.astype(jnp.float32))
+            stat = (jnp.max(ax) if self.calib_percentile >= 100.0
+                    else jnp.percentile(ax, self.calib_percentile))
+            running = self.variable("quant", "act_scale",
+                                    lambda: jnp.zeros((), jnp.float32))
+            running.value = jnp.maximum(running.value, stat)
+            y = jax.lax.conv_general_dilated(
+                x.astype(dt), kernel.astype(dt),
+                (self.stride, self.stride), pad, dimension_numbers=dn)
+        elif self.mode == "int8":
+            # the calibrated clip range MUST be provided (the scales
+            # pytree as the `quant` collection): a missing entry fails
+            # flax's immutable-collection check loudly
+            clip_range = self.variable(
+                "quant", "act_scale",
+                lambda: jnp.ones((), jnp.float32)).value
+            xq, a_scale = quantize_activations(x, clip_range)
+            wq, w_scale = quantize_weights(kernel)
+            acc = jax.lax.conv_general_dilated(
+                xq, wq, (self.stride, self.stride), pad,
+                dimension_numbers=dn, preferred_element_type=jnp.int32)
+            y = acc.astype(dt) * (a_scale * w_scale).astype(dt)
+        else:
+            raise NotImplementedError("Not expected quant mode: %s"
+                                      % self.mode)
+        return y + bias.astype(dt)
+
+
 class Convolution(nn.Module):
     """Conv -> optional BN -> activation (ref hourglass.py:94-108), with the
-    reference's symmetric (k-1)//2 padding."""
+    reference's symmetric (k-1)//2 padding.
+
+    Inference-compression attributes (ops/quant.py): `fold_bn` consumes
+    the BN-folded param pytree — the conv gains a bias, the BatchNorm
+    module disappears; `quant_mode` swaps the conv body for `QuantConv`
+    on the folded convs (`self.bn` and `quantize`; the stem and every
+    bn-less conv — head, inter-stack merges — stay in the float dtype:
+    the first/last-layer rule, and their contractions are not where the
+    roofline says the time is)."""
     out_ch: int
     kernel_size: int = 3
     stride: int = 1
@@ -173,21 +257,38 @@ class Convolution(nn.Module):
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
     stem_s2d: bool = False  # use the space-to-depth stem formulation
+    fold_bn: bool = False   # consume BN-folded params (inference only)
+    quant_mode: str = "off"  # off | calibrate | int8 (see QUANT_MODES)
+    calib_percentile: float = 100.0
+    quantize: bool = True   # eligibility: PreLayer's stem opts out
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         k, p = self.kernel_size, (self.kernel_size - 1) // 2
+        fold = self.bn and self.fold_bn
+        quant_active = self.quant_mode != "off" and self.quantize and self.bn
+        if quant_active and not fold:
+            raise ValueError(
+                "quant_mode=%r requires fold_bn: BN must be folded into "
+                "the conv before its weights are quantized (ops/quant.py)"
+                % self.quant_mode)
         if self.stem_s2d and k == 7 and self.stride == 2 and self.use_bias:
             # name matches the nn.Conv auto-name so the param tree (and
             # every checkpoint) is identical whichever path computes it
             x = StemConv(self.out_ch, s2d=True, dtype=self.dtype,
                          name="Conv_0")(x)
+        elif quant_active:
+            x = QuantConv(self.out_ch, kernel_size=k, stride=self.stride,
+                          padding=p, mode=self.quant_mode,
+                          calib_percentile=self.calib_percentile,
+                          dtype=self.dtype, name="Conv_0")(x)
         else:
             x = nn.Conv(self.out_ch, (k, k),
                         strides=(self.stride, self.stride),
-                        padding=((p, p), (p, p)), use_bias=self.use_bias,
+                        padding=((p, p), (p, p)),
+                        use_bias=self.use_bias or fold,
                         dtype=self.dtype)(x)
-        if self.bn:
+        if self.bn and not self.fold_bn:
             x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                              epsilon=1e-5, dtype=self.dtype,
                              axis_name=self.bn_axis_name)(x)
@@ -203,10 +304,15 @@ class Residual(nn.Module):
     activation: str = "ReLU"
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
+    fold_bn: bool = False
+    quant_mode: str = "off"
+    calib_percentile: float = 100.0
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
-        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+                  fold_bn=self.fold_bn, quant_mode=self.quant_mode,
+                  calib_percentile=self.calib_percentile)
         y = Convolution(self.out_ch, self.kernel_size, self.stride,
                         use_bias=False, bn=True, activation=self.activation,
                         **kw)(x, train)
@@ -234,11 +340,16 @@ class Hourglass(nn.Module):
     pool: str = "Max"
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
+    fold_bn: bool = False
+    quant_mode: str = "off"
+    calib_percentile: float = 100.0
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         kw = dict(activation=self.activation, dtype=self.dtype,
-                  bn_axis_name=self.bn_axis_name)
+                  bn_axis_name=self.bn_axis_name, fold_bn=self.fold_bn,
+                  quant_mode=self.quant_mode,
+                  calib_percentile=self.calib_percentile)
         mid_ch = self.in_ch + self.increase_ch
 
         up1 = Residual(self.in_ch, **kw)(x, train)
@@ -247,7 +358,9 @@ class Hourglass(nn.Module):
         if self.num_layer > 1:
             low = Hourglass(self.num_layer - 1, mid_ch, self.increase_ch,
                             self.activation, self.pool, self.dtype,
-                            self.bn_axis_name)(low, train)
+                            self.bn_axis_name, self.fold_bn,
+                            self.quant_mode, self.calib_percentile)(low,
+                                                                    train)
         else:
             low = Residual(mid_ch, **kw)(low, train)
         low = Residual(self.in_ch, **kw)(low, train)
@@ -271,13 +384,22 @@ class PreLayer(nn.Module):
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
     stem_s2d: bool = False
+    fold_bn: bool = False
+    quant_mode: str = "off"
+    calib_percentile: float = 100.0
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
-        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+                  fold_bn=self.fold_bn, quant_mode=self.quant_mode,
+                  calib_percentile=self.calib_percentile)
+        # the stem conv contracts over only 3 input channels and is the
+        # first layer: it stays in the float dtype (quantize=False) —
+        # folding its BN still applies
         x = Convolution(64, 7, 2, use_bias=True, bn=True,
                         activation=self.activation,
-                        stem_s2d=self.stem_s2d, **kw)(x, train)
+                        stem_s2d=self.stem_s2d, quantize=False,
+                        **kw)(x, train)
         x = Residual(self.mid_ch, **kw)(x, train)
         x = Pool(self.mid_ch, self.pool, dtype=self.dtype)(x)
         x = Residual(self.mid_ch, **kw)(x, train)
@@ -293,10 +415,15 @@ class Neck(nn.Module):
     pool: str = "None"
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
+    fold_bn: bool = False
+    quant_mode: str = "off"
+    calib_percentile: float = 100.0
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
-        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+                  fold_bn=self.fold_bn, quant_mode=self.quant_mode,
+                  calib_percentile=self.calib_percentile)
         x = Pool(self.ch, self.pool, dtype=self.dtype)(x)
         x = Convolution(self.ch, 1, bn=True, activation=self.activation,
                         **kw)(x, train)
@@ -341,10 +468,16 @@ class StackedHourglass(nn.Module):
     # stem/neck/head too) — the module then stays plain so the recompute
     # isn't doubly nested.
     stem_s2d: bool = False  # MXU-friendly space-to-depth stem conv
+    fold_bn: bool = False   # inference twin: BN folded into the convs
+    # (consumes ops/quant.fold_batchnorm params; training stays BN'd)
+    quant_mode: str = "off"  # off | calibrate | int8 (see QUANT_MODES)
+    calib_percentile: float = 100.0
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
-        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+                  fold_bn=self.fold_bn, quant_mode=self.quant_mode,
+                  calib_percentile=self.calib_percentile)
         if self.dtype is not None:
             x = x.astype(self.dtype)
         x = PreLayer(mid_ch=128, out_ch=self.in_ch, activation=self.activation,
@@ -380,10 +513,23 @@ class StackedHourglass(nn.Module):
 
 
 def build_model(args_or_cfg, dtype: Optional[Dtype] = None,
-                bn_axis_name: Optional[str] = None) -> StackedHourglass:
+                bn_axis_name: Optional[str] = None, fold_bn: bool = False,
+                quant_mode: str = "off",
+                calib_percentile: float = 100.0) -> StackedHourglass:
     """Construct the detector from a config namespace with the reference's
-    flag names (ref train.py:164-172 `load_network`)."""
+    flag names (ref train.py:164-172 `load_network`).
+
+    `fold_bn`/`quant_mode` build the inference-compression twin
+    (ops/quant.py): same architecture, BN folded into the convs and —
+    in `calibrate`/`int8` modes — the quantization machinery in place of
+    the folded conv bodies. Training models never set these."""
     c = args_or_cfg
+    if quant_mode not in QUANT_MODES:
+        raise ValueError("quant_mode must be one of %s, got %r"
+                         % (QUANT_MODES, quant_mode))
+    if quant_mode != "off" and not fold_bn:
+        raise ValueError("quant_mode=%r requires fold_bn=True (BN folds "
+                         "before quantization)" % quant_mode)
     return StackedHourglass(
         num_stack=c.num_stack,
         in_ch=c.hourglass_inch,
@@ -397,4 +543,7 @@ def build_model(args_or_cfg, dtype: Optional[Dtype] = None,
         bn_axis_name=bn_axis_name,
         remat=getattr(c, "remat", False),
         stem_s2d=getattr(c, "stem_s2d", False),
+        fold_bn=fold_bn,
+        quant_mode=quant_mode,
+        calib_percentile=calib_percentile,
     )

@@ -2,9 +2,21 @@ from .encode import encode_boxes, encode_boxes_batch, encode_boxes_jax, gaussian
 from .decode import decode_heatmap, decode_peak_scores, peak_mask
 from .loss import (focal_loss, normed_l1_loss, detection_loss, LossLog,
                    split_stack_predictions, stacked_detection_loss)
-from .nms import nms_mask, soft_nms_mask
+from .nms import maxpool_nms_mask, nms_mask, soft_nms_mask
+from .quant import (calibrate_scales, fold_batchnorm, load_scales,
+                    make_quant_model, quantize_activations, quantize_weights,
+                    save_scales, scales_hash)
 
 __all__ = [
+    "calibrate_scales",
+    "fold_batchnorm",
+    "load_scales",
+    "make_quant_model",
+    "maxpool_nms_mask",
+    "quantize_activations",
+    "quantize_weights",
+    "save_scales",
+    "scales_hash",
     "encode_boxes",
     "encode_boxes_batch",
     "encode_boxes_jax",

@@ -6,11 +6,18 @@ Capability parity with the reference NMS suite:
     TorchScript `nms_pytorch` (/root/reference/export.py:68-97);
   * `soft_nms_mask` — Gaussian-decay Soft-NMS, the fixed-iteration masked
     reformulation of the reference's O(N^2) python loop with data-dependent
-    swaps (/root/reference/evaluate.py:184-243).
+    swaps (/root/reference/evaluate.py:184-243);
+  * `maxpool_nms_mask` — PSRR-MaxpoolNMS-style suppression (PAPERS.md:
+    "accelerator-friendly NMS without sorting or sequential dependencies"):
+    boxes scatter onto a (position x scale x ratio) score grid and a box
+    survives iff it is the local max of its scale-matched pooling window —
+    the serial `fori_loop` greedy chain becomes scatter + reduce_window +
+    gather, all fully parallel. Approximate by design (agreement rate vs
+    `nms_mask` is tested, not exactness).
 
-Both operate on a fixed N with a validity mask and return masks/scores of
-the same fixed N — no data-dependent shapes anywhere, so the whole predict
-function (model -> decode -> NMS) compiles to a single XLA program.
+All three operate on a fixed N with a validity mask and return masks/scores
+of the same fixed N — no data-dependent shapes anywhere, so the whole
+predict function (model -> decode -> NMS) compiles to a single XLA program.
 """
 
 from __future__ import annotations
@@ -104,3 +111,73 @@ def soft_nms_mask(boxes: jax.Array, scores: jax.Array, valid: jax.Array,
     final_scores, _ = jax.lax.fori_loop(0, n, body, (scores, jnp.zeros((n,), bool)))
     keep = (final_scores > score_th) & valid
     return keep, final_scores
+
+
+@partial(jax.jit, static_argnames=("extent", "grid_size", "scale_bins",
+                                   "ratio_bins"))
+def maxpool_nms_mask(boxes: jax.Array, scores: jax.Array, valid: jax.Array,
+                     extent: float = 512.0, grid_size: int = 64,
+                     scale_bins: int = 4, ratio_bins: int = 3) -> jax.Array:
+    """Maxpool-based NMS: fully parallel, no sort, no sequential chain.
+
+    Each box scatters its score into a `(grid, grid, scale_bins *
+    ratio_bins)` map cell keyed by (center position, size octave, aspect
+    octave); suppression is one max-pool peak test per scale channel —
+    the SAME `reduce_window` machinery as the heatmap decode
+    (`ops.decode.peak_mask`) — with the pooling window sized to that
+    octave's representative box (centers closer than ~half a box suppress,
+    the maxpool analogue of IoU > 0.5). A box is kept iff it is valid, it
+    owns its cell's max, and its cell is the peak of its window.
+
+    Args:
+      boxes: (N, 4) xyxy at image scale.
+      scores: (N,) confidences.
+      valid: (N,) bool.
+      extent: image extent the boxes live in (static — the grid geometry
+        is baked into the program).
+      grid_size / scale_bins / ratio_bins: map geometry (static).
+
+    Returns: (N,) bool keep mask, original order. Approximate: boxes in
+    adjacent scale/ratio octaves never suppress each other and cell
+    quantization shifts borderline pairs — parity with `nms_mask` is an
+    agreement RATE (tested), the price of replacing the O(N) serial
+    greedy chain with O(1) depth of parallel ops.
+    """
+    from .decode import peak_mask
+
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    cx = jnp.clip((x1 + x2) * 0.5, 0.0, extent * (1 - 1e-6))
+    cy = jnp.clip((y1 + y2) * 0.5, 0.0, extent * (1 - 1e-6))
+    w = jnp.maximum(x2 - x1, 1e-3)
+    h = jnp.maximum(y2 - y1, 1e-3)
+
+    rel = jnp.sqrt(w * h) / extent
+    sbin = jnp.clip(jnp.floor(jnp.log2(rel)).astype(jnp.int32) + scale_bins,
+                    0, scale_bins - 1)
+    rbin = jnp.clip(jnp.floor(jnp.log2(w / h) + 0.5).astype(jnp.int32)
+                    + ratio_bins // 2, 0, ratio_bins - 1)
+    ch = sbin * ratio_bins + rbin
+
+    g = grid_size
+    gx = jnp.clip((cx / extent * g).astype(jnp.int32), 0, g - 1)
+    gy = jnp.clip((cy / extent * g).astype(jnp.int32), 0, g - 1)
+
+    # scatter-max the scores; background stays below any real score
+    smap = jnp.full((g, g, scale_bins * ratio_bins), _NEG, jnp.float32)
+    smap = smap.at[gy, gx, ch].max(
+        jnp.where(valid, scores, _NEG).astype(jnp.float32))
+
+    # per-scale-octave pooling window: the octave's geometric-mean box
+    # size, halved (IoU>0.5 ~ centers within half a box), in grid cells
+    cell = extent / g
+    peak_blocks = []
+    for b in range(scale_bins):
+        s_rep = extent * (2.0 ** (b + 0.5 - scale_bins))
+        half = max(1, int(round(s_rep / (2.0 * cell))))
+        blk = smap[:, :, b * ratio_bins:(b + 1) * ratio_bins]
+        peak_blocks.append(peak_mask(blk, 2 * half + 1))
+    peaks = jnp.concatenate(peak_blocks, axis=-1)
+
+    cellv = smap[gy, gx, ch]
+    is_peak = peaks[gy, gx, ch]
+    return valid & is_peak & (scores.astype(jnp.float32) >= cellv)

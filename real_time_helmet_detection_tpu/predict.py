@@ -24,12 +24,12 @@ import jax
 import jax.numpy as jnp
 
 from .ops.decode import Detections, decode_heatmap, decode_peak_scores
-from .ops.nms import nms_mask, soft_nms_mask
+from .ops.nms import maxpool_nms_mask, nms_mask, soft_nms_mask
 from .ops.pallas import fused_peak_scores
 
 
 def make_predict_fn(model, cfg, normalize: str | None = None,
-                    mesh=None) -> Callable:
+                    mesh=None, quant_scales=None) -> Callable:
     """Build `predict(variables, images) -> Detections` (batched, jitted).
 
     images: (B, H, W, 3) normalized float32 — or, when `normalize` names a
@@ -43,6 +43,15 @@ def make_predict_fn(model, cfg, normalize: str | None = None,
     "data" axis (variables replicated), so evaluation data-parallelizes
     over every device. The reference's eval is single-GPU only
     (ref evaluate.py:16); this is the multi-chip eval path.
+
+    `--infer-dtype int8` (cfg.infer_dtype; requires `quant_scales`, the
+    calibrated activation-scales pytree from `ops.quant.calibrate_scales`
+    / `load_scales`): the network runs the BN-folded int8-quantized twin —
+    BN fold and weight quantization happen INSIDE the jitted program from
+    the SAME checkpoint pytree, so `predict(variables, images)` keeps its
+    signature and the artifact contract is "checkpoint + scales in".
+    Decode/NMS always stay float. Eval/export only — training is never
+    quantized (docs/ARCHITECTURE.md "Inference compression").
 
     Returns `Detections` with leading batch dim and N = num_stack * topk
     entries per image; `valid` combines the conf threshold and the NMS
@@ -64,13 +73,29 @@ def make_predict_fn(model, cfg, normalize: str | None = None,
         raise ValueError("pool_size must be odd and >= 1, got %d" % pool_size)
     normalized = bool(cfg.normalized_coord)
     use_soft = cfg.nms == "soft-nms"
-    if cfg.nms not in ("nms", "soft-nms"):
+    use_maxpool = cfg.nms == "maxpool"
+    if cfg.nms not in ("nms", "soft-nms", "maxpool"):
         raise NotImplementedError("Not expected nms algorithm: %s" % cfg.nms)
     # The fused Pallas sigmoid+peak kernel replaces the XLA reduce_window
     # path on TPU; off-TPU it would run in (slow) interpret mode, so gate on
     # the actual backend as well as the flag.
     use_pallas = bool(getattr(cfg, "use_pallas", True)) and \
         jax.default_backend() == "tpu"
+    imsize = int(cfg.imsize or 512)  # maxpool-NMS grid extent (static)
+
+    infer_dtype = getattr(cfg, "infer_dtype", "bf16")
+    if infer_dtype not in ("bf16", "int8"):
+        raise NotImplementedError("Not expected infer dtype: %s"
+                                  % infer_dtype)
+    if infer_dtype == "int8":
+        if quant_scales is None:
+            raise ValueError(
+                "--infer-dtype int8 needs calibrated activation scales: "
+                "pass quant_scales (ops.quant.calibrate_scales or "
+                "load_scales of a saved artifact)")
+        from .ops.quant import fold_batchnorm, make_quant_model
+        qmodel = make_quant_model(cfg, dtype=model.dtype, mode="int8")
+        scales = jax.tree.map(jnp.asarray, quant_scales)
 
     def decode_one(o: jax.Array) -> Detections:
         """One stack of one image: (H, W, num_cls+4) raw -> Detections."""
@@ -91,6 +116,12 @@ def make_predict_fn(model, cfg, normalize: str | None = None,
 
     def suppress(boxes, scores, valid):
         """Cross-stack class-agnostic NMS (ref evaluate.py:155-163, 167-180)."""
+        if use_maxpool:
+            # PSRR-MaxpoolNMS-style parallel suppression (ops/nms.py):
+            # no sort, no serial greedy chain — approximate parity with
+            # `nms` (agreement-rate tested, not exactness)
+            keep = maxpool_nms_mask(boxes, scores, valid, extent=float(imsize))
+            return keep, scores
         if use_soft:
             # score_th = conf_th matches the reference CALL SITE, which
             # overrides soft_nms_pytorch's 0.001 default with the --conf-th
@@ -109,7 +140,18 @@ def make_predict_fn(model, cfg, normalize: str | None = None,
         if normalize is not None:
             images = (images.astype(jnp.float32) / 255.0 - norm_mean) \
                 / norm_std
-        out = model.apply(variables, images, train=False)  # (B, S, H, W, C+4)
+        if infer_dtype == "int8":
+            # BN fold + per-channel weight quantization run INSIDE the
+            # program from the training checkpoint (O(params) once per
+            # dispatch, fused by XLA); the calibrated activation scales
+            # ride along as the `quant` collection
+            folded = fold_batchnorm(variables["params"],
+                                    variables["batch_stats"])
+            out = qmodel.apply({"params": folded, "quant": scales},
+                               images, train=False)
+        else:
+            out = model.apply(variables, images, train=False)
+        # (B, S, H, W, C+4)
         b, s = out.shape[0], out.shape[1]
         dets = jax.vmap(jax.vmap(decode_one))(out)          # (B, S, topk, ...)
         boxes = dets.boxes.reshape(b, s * topk, 4)

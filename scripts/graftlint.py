@@ -263,6 +263,21 @@ def _selfcheck_trace(check) -> None:
     check("trace/dynamic-shape silent on static shapes",
           not ta.stablehlo_findings(lambda v: v * 2.0, (x,), "fix"))
 
+    # the quantized predict entry point (ISSUE 5): the int8 twin's trace
+    # must pass the dynamic-shape/f64/donation rules like every other
+    # production surface — the fold + round/clip/conv-int32 body is easy
+    # to get wrong in exactly these ways (a np.percentile host call, an
+    # f64 rsqrt, a chain that drops its carry)
+    predict_q, variables_q, images_q = ta._tiny_predict_int8_parts()
+    qf = ta.audit_entry(lambda v, im: predict_q(v, im),
+                        (variables_q, images_q), "predict_int8")
+    check("quantized predict audits clean", not qf)
+    qc = ta.audit_entry(ta._predict_chain(predict_q),
+                        (variables_q, images_q), "predict_int8_chain",
+                        donate_argnums=(1,), lower=False)
+    check("quantized predict chain donation ok",
+          not any(f.rule == "trace/donation" for f in qc) and not qc)
+
 
 def selfcheck() -> int:
     t0 = time.time()

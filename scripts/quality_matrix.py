@@ -22,6 +22,10 @@ class imbalance) and records held-out mAP for each lever:
               -3.2 mAP result used the same horizon at a 600-step-shorter
               budget, so this row resolves decay-vs-budget with data)
   base+pool5  same weights, 5x5 peak window           (eval only)
+  base+int8   same weights, BN-folded int8 predict    (eval only;
+              --infer-dtype int8, ops/quant.py — records
+              delta_map_vs_bf16, the mAP-parity gate for the int8
+              inference engine: same checkpoint, both dtypes)
   stack2      num_stack=2                             (1 training)
   multiscale  bucketed {384,448,512} on a 576 canvas  (1 training)
   multiscale+soft         same multiscale weights, soft-NMS (eval only)
@@ -228,7 +232,7 @@ def main() -> None:
     # ---- base training (also yields EMA weights + soft-NMS eval rows) ---
     base_save = os.path.join(WORK_ROOT, "base")
     if want("base") or want("base+soft") or want("base+ema") \
-            or want("base+pool5"):
+            or want("base+pool5") or want("base+int8"):
         run_training(base_save, train_cfg(base_save))
     if want("base"):
         t0 = time.time()
@@ -251,6 +255,22 @@ def main() -> None:
         m = evaluate(eval_cfg(base_save, latest_ckpt(base_save),
                               pool_size=5))
         record("base+pool5", m, t0, base_save)
+    if want("base+int8"):
+        # the int8-vs-bf16 column (ISSUE 5): the SAME base checkpoint
+        # through the BN-folded post-training-quantized predict
+        # (--infer-dtype int8; scales self-calibrated from the first
+        # --calib-batches eval batches and persisted under the run's
+        # calibration/). The parity gate is delta_map_vs_bf16 against the
+        # float row — quantization must buy speed, not quality.
+        t0 = time.time()
+        m = evaluate(eval_cfg(base_save, latest_ckpt(base_save),
+                              infer_dtype="int8"))
+        extra = {"infer_dtype": "int8"}
+        if "base" in results["rows"]:
+            extra["delta_map_vs_bf16"] = round(
+                float(m["map"]) - results["rows"]["base"]["mAP"], 4)
+            log("int8 vs bf16 dmAP: %+.4f" % extra["delta_map_vs_bf16"])
+        record("base+int8", m, t0, base_save, extra=extra)
 
     # ---- num_stack=2 ----------------------------------------------------
     if want("stack2"):

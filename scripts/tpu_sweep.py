@@ -73,7 +73,8 @@ OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
 SECTION_KEYS = {"inference": "inference_batch_sweep",
                 "train": "train_batch_sweep",
                 "stack2": "num_stack2", "remat": "remat",
-                "stack4_768": "stack4_768", "step_grid": "step_grid"}
+                "stack4_768": "stack4_768", "step_grid": "step_grid",
+                "int8": "int8_inference"}
 
 
 def merge_prior(results: dict, prior: dict, only: set) -> dict:
@@ -151,6 +152,7 @@ def main() -> None:
         "dispatch_ms": round(overhead * 1e3, 3),
         "inference_batch_sweep": [], "train_batch_sweep": [],
         "num_stack2": {}, "remat": [], "stack4_768": [], "step_grid": [],
+        "int8_inference": [],
     }
     def read_prior(path):
         """Prior results at `path`, or None if absent/unreadable — a kill
@@ -292,6 +294,49 @@ def main() -> None:
             rec["memory"] = mem
         return rec
 
+    def bench_int8(batch, n):
+        """Float vs int8 predict chain at one batch size (ISSUE 5): same
+        checkpoint pytree, scales from a synthetic calibration pass (the
+        chip measurement wants the CONV speedup; mAP parity is the CPU
+        fixture's job, tests/test_quant.py). Both chains use the same
+        donation/timing methodology as bench_inference."""
+        import dataclasses
+
+        from real_time_helmet_detection_tpu.ops.quant import (
+            calibrate_scales, synthetic_calibration_batches)
+        cfg = Config(num_stack=1, hourglass_inch=128, num_cls=2,
+                     topk=100, conf_th=0.0, nms_th=0.5, imsize=imsize)
+        model = build_model(cfg, dtype=jnp.bfloat16)
+        params, batch_stats = init_variables(model, jax.random.key(0), imsize)
+        variables = {"params": params, "batch_stats": batch_stats}
+        scales = calibrate_scales(
+            cfg, variables,
+            synthetic_calibration_batches(batch, imsize, n=2),
+            dtype=jnp.bfloat16)
+        rec = {"batch": batch}
+        for dtype_name in ("bf16", "int8"):
+            icfg = dataclasses.replace(cfg, infer_dtype=dtype_name)
+            predict = make_predict_fn(
+                model, icfg,
+                quant_scales=scales if dtype_name == "int8" else None)
+            images = jnp.asarray(rng.standard_normal(
+                (batch, imsize, imsize, 3)).astype(np.float32))
+            t0 = time.perf_counter()
+            compiled = predict_chain(predict, n).lower(
+                variables, images).compile()
+            compile_s = time.perf_counter() - t0
+            images, s = compiled(variables, images)  # warmup (donates)
+            np.asarray(s)
+            dt = chain_timed_fetch(compiled, variables, images, overhead)
+            rec[dtype_name] = {
+                "img_per_sec": round(batch * n / dt, 1),
+                "ms_per_batch": round(dt / n * 1e3, 3),
+                "compile_s": round(compile_s, 1)}
+            hb.beat("int8 section b=%d %s done" % (batch, dtype_name))
+        rec["int8_vs_bf16"] = round(
+            rec["int8"]["img_per_sec"] / rec["bf16"]["img_per_sec"], 3)
+        return rec
+
     # --- 1. inference batch sweep ----------------------------------------
     if want("inference"):
         for batch in ([1, 2, 4, 8, 16, 32] if on_tpu else [1, 2]):
@@ -412,6 +457,38 @@ def main() -> None:
             results["step_grid_selected"] = max(
                 ok, key=lambda r: r["img_per_sec_chip"])
             log("step_grid selected: %s" % results["step_grid_selected"])
+            flush()
+
+    # --- 7. int8 inference A/B (ISSUE 5) ----------------------------------
+    # (the v5e's int8 MXU path is 2x the bf16 peak; the predict step is
+    # conv-bound per PR 2's roofline — this section measures how much of
+    # the 2x the BN-folded quantized predict actually realizes, per batch.
+    # Each batch cell flushes independently so a tunnel kill loses at most
+    # the in-flight cell; `--only int8` reruns just this section.)
+    if want("int8"):
+        # per-config resume: successful cells from the prior run survive a
+        # mid-sweep kill even when `--only int8` reruns the section —
+        # only failed/missing batches are re-measured
+        prior_cells = [r for r in (prior or {}).get("int8_inference", [])
+                       if "int8_vs_bf16" in r]
+        for r in prior_cells:
+            if r not in results["int8_inference"]:
+                results["int8_inference"].append(r)
+        done = {r.get("batch") for r in results["int8_inference"]
+                if "int8_vs_bf16" in r}
+        for batch in ([1, 4, 16, 32] if on_tpu else [2]):
+            if batch in done:
+                log("int8 b=%d already measured; skipping" % batch)
+                continue
+            n = max(32, min(512, 4096 // batch)) if on_tpu else 2
+            try:
+                rec = bench_int8(batch, n)
+                results["int8_inference"].append(rec)
+                log("int8 b=%d: %s" % (batch, rec))
+            except Exception as e:  # noqa: BLE001
+                results["int8_inference"].append(
+                    {"batch": batch, "error": str(e).splitlines()[-1][:200]})
+                log("int8 b=%d FAILED: %r" % (batch, e))
             flush()
 
     flush()

@@ -193,7 +193,39 @@ def test_sweep_section_keys_cover_all_result_lists():
     sweep = _load_sweep()
     assert set(sweep.SECTION_KEYS.values()) == {
         "inference_batch_sweep", "train_batch_sweep", "num_stack2", "remat",
-        "stack4_768", "step_grid"}
+        "stack4_768", "step_grid", "int8_inference"}
+
+
+def test_find_last_tpu_result_carries_int8_fields(tmp_path):
+    """ISSUE 5 satellite: the JSON line's new infer_dtype/int8 keys must
+    survive find_last_tpu_result, and existing consumers see the same
+    core fields as before (value/mfu/latency untouched)."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r08", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1250.0,
+        "mfu_train": 0.53, "latency_ms_b1": 1.4, "infer_dtype": "int8",
+        "int8_fps": 2100.0, "int8_vs_bf16": 1.68})
+    got = bench.find_last_tpu_result(root)
+    assert got["infer_dtype"] == "int8"
+    assert got["int8_fps"] == 2100.0
+    assert got["int8_vs_bf16"] == 1.68
+    # pre-existing consumer contract unchanged
+    assert got["value"] == 1250.0
+    assert got["mfu_train"] == 0.53
+    assert got["latency_ms_b1"] == 1.4
+
+
+def test_find_last_tpu_result_old_lines_unaffected_by_int8_keys(tmp_path):
+    """A pre-int8 artifact (no infer_dtype key) must still resolve with
+    the same fields as before — consumers never see a surprise key."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r04", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1207.7,
+        "mfu_train": 0.5278})
+    got = bench.find_last_tpu_result(root)
+    assert got["value"] == 1207.7
+    assert "infer_dtype" not in got
+    assert "int8_fps" not in got
 
 
 def test_bytes_of_reports_cost_analysis_bytes():

@@ -1,11 +1,14 @@
 """NMS tests: greedy hard NMS vs a trivial O(N^2) numpy oracle, soft-NMS
-decay semantics, and masked fixed-shape behavior."""
+decay semantics, masked fixed-shape behavior, and the PSRR-style maxpool
+NMS's agreement rate vs the greedy chain (approximate by design — ISSUE 5
+satellite)."""
 
 import numpy as np
 import jax.numpy as jnp
 import pytest
 
-from real_time_helmet_detection_tpu.ops import nms_mask, soft_nms_mask
+from real_time_helmet_detection_tpu.ops import (maxpool_nms_mask, nms_mask,
+                                                soft_nms_mask)
 
 
 def _np_greedy_nms(boxes, scores, iou_th):
@@ -176,6 +179,88 @@ def test_soft_nms_invalid_entries_ignored_vs_oracle():
     # invalid entries keep their input scores (decay never touches them)
     np.testing.assert_allclose(np.asarray(new_scores)[~valid],
                                scores[~valid], rtol=1e-6)
+
+
+def _clustered_boxes(seed, n, ncl, jitter, wlo, whi, extent=512.0):
+    rng = np.random.RandomState(seed)
+    centers = rng.uniform(60, extent - 60, (ncl, 2))
+    xy = centers[rng.randint(0, ncl, n)] + rng.uniform(-jitter, jitter,
+                                                       (n, 2))
+    wh = rng.uniform(wlo, whi, (n, 2))
+    boxes = np.clip(np.concatenate([xy - wh / 2, xy + wh / 2], 1),
+                    0, extent).astype(np.float32)
+    scores = rng.uniform(0.1, 1.0, n).astype(np.float32)
+    return boxes, scores
+
+
+def test_maxpool_nms_collapses_duplicates():
+    boxes = jnp.asarray([[100, 100, 160, 160]] * 5, jnp.float32)
+    scores = jnp.asarray([0.5, 0.9, 0.7, 0.6, 0.8])
+    keep = np.asarray(maxpool_nms_mask(boxes, scores, jnp.ones(5, bool),
+                                       extent=512.0))
+    assert keep.tolist() == [False, True, False, False, False]
+
+
+def test_maxpool_nms_disjoint_kept():
+    boxes = jnp.asarray([[0, 0, 60, 60], [200, 200, 260, 260],
+                         [400, 0, 460, 60]], jnp.float32)
+    keep = np.asarray(maxpool_nms_mask(boxes, jnp.asarray([0.9, 0.8, 0.7]),
+                                       jnp.ones(3, bool), extent=512.0))
+    assert keep.all()
+
+
+def test_maxpool_nms_invalid_never_kept():
+    boxes = jnp.asarray([[0, 0, 60, 60], [300, 300, 360, 360]], jnp.float32)
+    keep = np.asarray(maxpool_nms_mask(boxes, jnp.asarray([0.9, 0.8]),
+                                       jnp.asarray([False, True]),
+                                       extent=512.0))
+    assert keep.tolist() == [False, True]
+
+
+def test_maxpool_nms_agreement_rate_vs_greedy():
+    """The documented parity contract: per-box keep agreement RATE vs
+    `nms_mask`, not exactness (adjacent-octave pairs and cell-quantized
+    borderline pairs legitimately differ). Bounds are calibrated on these
+    exact generators (mean measured ~0.96 duplicate-heavy / ~0.74 mixed;
+    asserted with margin so only a real regression trips)."""
+    def rate(boxes, scores):
+        n = len(scores)
+        k_greedy = np.asarray(nms_mask(jnp.asarray(boxes),
+                                       jnp.asarray(scores),
+                                       jnp.ones(n, bool), 0.5))
+        k_pool = np.asarray(maxpool_nms_mask(jnp.asarray(boxes),
+                                             jnp.asarray(scores),
+                                             jnp.ones(n, bool),
+                                             extent=512.0))
+        return float((k_greedy == k_pool).mean())
+
+    # duplicate-heavy, one size octave: the deployment regime (many
+    # near-identical candidates per object) — high agreement expected
+    dup = [rate(*_clustered_boxes(s, 48, 12, 4, 40, 60)) for s in range(6)]
+    # mixed sizes + looser clusters: the adversarial regime for a
+    # scale-binned method — agreement degrades but stays well above chance
+    mixed = [rate(*_clustered_boxes(s, 48, 12, 10, 40, 70))
+             for s in range(6)]
+    assert np.mean(dup) >= 0.9 and min(dup) >= 0.85, dup
+    assert np.mean(mixed) >= 0.6, mixed
+
+
+def test_maxpool_nms_through_predict_fn():
+    """`--nms maxpool` must thread end-to-end through make_predict_fn."""
+    import jax
+
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.models import build_model
+    from real_time_helmet_detection_tpu.predict import make_predict_fn
+
+    cfg = Config(num_stack=1, hourglass_inch=16, num_cls=2, topk=10,
+                 conf_th=0.1, nms_th=0.5, imsize=64, nms="maxpool")
+    model = build_model(cfg)
+    imgs = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), imgs, train=False)
+    dets = jax.device_get(make_predict_fn(model, cfg)(variables, imgs))
+    assert dets.boxes.shape == (1, cfg.num_stack * cfg.topk, 4)
+    assert dets.valid.dtype == bool
 
 
 def test_nms_three_hundred_near_duplicates_keep_one():

@@ -1,0 +1,292 @@
+"""Inference-compression tests (ISSUE 5, ops/quant.py): BN-fold logit
+parity, int8 weight/activation quantization bounds, the quantized predict
+path, the scales artifact contract, end-to-end eval mAP parity on the
+synthetic fixture, and int8 export metadata provenance.
+
+The reference has no inference compression at all (it serves the fp32
+training graph through TorchScript, ref export.py:55); every bound here
+pins an upgrade.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_helmet_detection_tpu.config import Config
+from real_time_helmet_detection_tpu.models import build_model
+from real_time_helmet_detection_tpu.ops.quant import (
+    calibrate_scales, fold_batchnorm, load_scales, make_quant_model,
+    quantize_activations, quantize_weights, save_scales, scales_hash,
+    synthetic_calibration_batches)
+from real_time_helmet_detection_tpu.predict import make_predict_fn
+
+
+def tiny_cfg(**kw):
+    base = dict(num_stack=1, hourglass_inch=16, num_cls=2, topk=10,
+                conf_th=0.1, nms_th=0.5, imsize=64, batch_size=2,
+                num_workers=2)
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.fixture(scope="module")
+def tiny_state():
+    """One tiny fp32 model + init per module: the fold/quant tests only
+    read it."""
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    imgs = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (2, 64, 64, 3)).astype(np.float32))
+    variables = jax.jit(
+        lambda r, x: model.init(r, x, train=False))(jax.random.key(0), imgs)
+    return cfg, model, variables, imgs
+
+
+# ---------------------------------------------------------------------------
+# BN folding
+
+
+def test_fold_batchnorm_logits_allclose(tiny_state):
+    """The acceptance bound: BN-folded logits allclose (fp32, atol 1e-4)
+    to the unfolded training graph on the same checkpoint pytree."""
+    cfg, model, variables, imgs = tiny_state
+    folded = fold_batchnorm(variables["params"], variables["batch_stats"])
+    fmodel = build_model(cfg, fold_bn=True)
+    y_ref = np.asarray(model.apply(variables, imgs, train=False))
+    y_fold = np.asarray(fmodel.apply({"params": folded}, imgs, train=False))
+    np.testing.assert_allclose(y_fold, y_ref, atol=1e-4, rtol=0)
+
+
+def test_fold_batchnorm_drops_all_bn_and_adds_bias(tiny_state):
+    _, _, variables, _ = tiny_state
+    folded = fold_batchnorm(variables["params"], variables["batch_stats"])
+    flat = jax.tree_util.tree_flatten_with_path(folded)[0]
+    paths = ["/".join(str(k) for k in p) for p, _ in flat]
+    assert not any("BatchNorm" in p for p in paths)
+    # every conv that HAD a BN sibling now carries a bias
+    n_bn = len([p for p, _ in jax.tree_util.tree_flatten_with_path(
+        variables["batch_stats"])[0]]) // 2  # mean+var per BN
+    n_bias = sum(1 for p in paths if "Conv_0" in p and "bias" in p)
+    assert n_bias >= n_bn > 0
+
+
+def test_fold_batchnorm_missing_stats_raises(tiny_state):
+    _, _, variables, _ = tiny_state
+    with pytest.raises(ValueError, match="mean/var"):
+        fold_batchnorm(variables["params"], {})
+
+
+# ---------------------------------------------------------------------------
+# weight / activation quantization bounds
+
+
+def test_quantize_weights_per_channel_bound():
+    """q * scale reconstructs the kernel within scale/2 per channel (the
+    acceptance's quantize->dequantize bound), |q| <= 127, scales > 0."""
+    rng = np.random.default_rng(1)
+    # channel magnitudes spread over orders of magnitude — the regime that
+    # makes per-channel (not per-tensor) scaling necessary
+    k = rng.standard_normal((3, 3, 8, 16)).astype(np.float32) \
+        * np.logspace(-3, 1, 16, dtype=np.float32)
+    q, scale = quantize_weights(k)
+    q, scale = np.asarray(q), np.asarray(scale)
+    assert q.dtype == np.int8 and np.abs(q).max() <= 127
+    assert (scale > 0).all()
+    err = np.abs(q.astype(np.float32) * scale - k)
+    per_ch = err.reshape(-1, 16).max(axis=0)
+    assert (per_ch <= scale / 2 + 1e-7).all(), (per_ch, scale)
+
+
+def test_quantize_weights_zero_channel_safe():
+    k = np.zeros((3, 3, 4, 4), np.float32)
+    q, scale = quantize_weights(k)
+    assert np.isfinite(np.asarray(scale)).all() and (np.asarray(scale) > 0).all()
+    assert (np.asarray(q) == 0).all()
+
+
+def test_quantize_activations_clip_roundtrip():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((4, 8, 8, 3)).astype(np.float32) * 3.0
+    absmax = np.float32(2.0)
+    q, scale = quantize_activations(jnp.asarray(x), absmax)
+    deq = np.asarray(q, np.float32) * float(scale)
+    clipped = np.clip(x, -2.0, 2.0)
+    assert np.abs(deq - clipped).max() <= float(scale) / 2 + 1e-6
+    assert np.abs(np.asarray(q)).max() <= 127
+
+
+# ---------------------------------------------------------------------------
+# quantized predict path
+
+
+def test_int8_predict_matches_float_detections(tiny_state):
+    """Same checkpoint, both numeric paths: the int8 twin's detections
+    stay close to the float graph's on random inputs (score atol well
+    inside the conf-threshold granularity; valid/class sets agree)."""
+    cfg, model, variables, imgs = tiny_state
+    scales = calibrate_scales(
+        cfg, variables, synthetic_calibration_batches(2, 64, n=2))
+    icfg = dataclasses.replace(cfg, infer_dtype="int8")
+    d_f = jax.device_get(make_predict_fn(model, cfg)(variables, imgs))
+    d_q = jax.device_get(
+        make_predict_fn(model, icfg, quant_scales=scales)(variables, imgs))
+    assert d_q.boxes.shape == d_f.boxes.shape
+    assert np.abs(d_f.scores - d_q.scores).max() < 0.05
+    assert (d_f.valid == d_q.valid).mean() >= 0.9
+    both = d_f.valid & d_q.valid
+    if both.any():
+        assert (d_f.classes == d_q.classes)[both].mean() >= 0.9
+
+
+def test_predict_int8_requires_scales(tiny_state):
+    cfg, model, _, _ = tiny_state
+    with pytest.raises(ValueError, match="quant_scales"):
+        make_predict_fn(model, dataclasses.replace(cfg, infer_dtype="int8"))
+
+
+def test_build_model_quant_requires_fold():
+    cfg = tiny_cfg()
+    with pytest.raises(ValueError, match="fold_bn"):
+        build_model(cfg, quant_mode="int8")
+    with pytest.raises(ValueError, match="quant_mode"):
+        build_model(cfg, fold_bn=True, quant_mode="int4")
+
+
+def test_calibrate_percentile_tightens_scales(tiny_state):
+    """A sub-100 percentile clips outliers: every calibrated scale is
+    <= its abs-max twin, and at least one is strictly tighter."""
+    cfg, _, variables, _ = tiny_state
+    batches = list(synthetic_calibration_batches(2, 64, n=2))
+    s_max = calibrate_scales(cfg, variables, iter(batches))
+    s_p90 = calibrate_scales(cfg, variables, iter(batches), percentile=90.0)
+    hi = np.array(jax.tree.leaves(s_max))
+    lo = np.array(jax.tree.leaves(s_p90))
+    assert (lo <= hi + 1e-7).all()
+    assert (lo < hi - 1e-7).any()
+
+
+# ---------------------------------------------------------------------------
+# scales artifact
+
+
+def test_scales_artifact_roundtrip_and_hash(tiny_state, tmp_path):
+    cfg, _, variables, _ = tiny_state
+    scales = calibrate_scales(
+        cfg, variables, synthetic_calibration_batches(2, 64, n=2))
+    path = str(tmp_path / "calibration" / "quant_scales.json")
+    digest = save_scales(path, scales, meta={"calib_batches": 2})
+    assert digest == scales_hash(scales)  # hash is content-addressed
+    back = load_scales(path)
+    a = np.array(jax.tree.leaves(scales), np.float32)
+    b = np.array(jax.tree.leaves(back), np.float32)
+    np.testing.assert_allclose(b, a, rtol=1e-6)
+    rec = json.load(open(path))
+    assert rec["format"] == "quant-scales-v1"
+    assert rec["sha256"] == digest
+    assert rec["calib_batches"] == 2
+    # no tmp residue: the write is atomic (tmp + os.replace)
+    leftovers = [n for n in os.listdir(str(tmp_path / "calibration"))
+                 if ".tmp." in n]
+    assert leftovers == []
+
+
+def test_load_scales_rejects_wrong_format(tmp_path):
+    p = str(tmp_path / "bad.json")
+    with open(p, "w") as f:
+        json.dump({"format": "something-else", "scales": {}}, f)
+    with pytest.raises(ValueError, match="quant-scales-v1"):
+        load_scales(p)
+
+
+def test_quant_model_int8_consumes_artifact_scales(tiny_state, tmp_path):
+    """The artifact roundtrip feeds the int8 twin exactly like the live
+    calibration pytree — the eval `--quant-scales` path."""
+    cfg, model, variables, imgs = tiny_state
+    scales = calibrate_scales(
+        cfg, variables, synthetic_calibration_batches(2, 64, n=2))
+    path = str(tmp_path / "s.json")
+    save_scales(path, scales)
+    folded = fold_batchnorm(variables["params"], variables["batch_stats"])
+    qmodel = make_quant_model(cfg, mode="int8")
+    y_live = qmodel.apply(
+        {"params": folded, "quant": jax.tree.map(jnp.asarray, scales)},
+        imgs, train=False)
+    y_art = qmodel.apply(
+        {"params": folded,
+         "quant": jax.tree.map(jnp.asarray, load_scales(path))},
+        imgs, train=False)
+    np.testing.assert_allclose(np.asarray(y_art), np.asarray(y_live),
+                               atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: eval mAP parity + export provenance
+
+
+@pytest.fixture(scope="module")
+def fixture_root(tmp_path_factory):
+    from real_time_helmet_detection_tpu.data import make_synthetic_voc
+    root = tmp_path_factory.mktemp("voc_quant")
+    return make_synthetic_voc(str(root), num_train=6, num_test=4,
+                              imsize=(96, 72), seed=1)
+
+
+def test_int8_eval_map_parity_synthetic_fixture(fixture_root, tmp_path):
+    """The acceptance gate: the full eval driver, same checkpoint, both
+    infer dtypes — int8 mAP within 1.5 points of bf16 on the synthetic
+    fixture, and the self-calibration pass persists its scales artifact."""
+    from real_time_helmet_detection_tpu.evaluate import evaluate
+
+    save_f = str(tmp_path / "bf16")
+    save_q = str(tmp_path / "int8")
+    base = dict(data=fixture_root, train_flag=False, random_seed=3)
+    m_f = evaluate(tiny_cfg(save_path=save_f, **base))
+    m_q = evaluate(tiny_cfg(save_path=save_q, infer_dtype="int8",
+                            calib_batches=2, **base))
+    assert abs(m_q["map"] - m_f["map"]) <= 0.015, (m_f["map"], m_q["map"])
+    scales_path = os.path.join(save_q, "calibration", "quant_scales.json")
+    assert os.path.exists(scales_path)
+    rec = json.load(open(scales_path))
+    assert rec["format"] == "quant-scales-v1" and rec["sha256"]
+
+
+def test_export_int8_metadata_records_scales_hash(tmp_path):
+    """meta.json must pin infer_dtype + the sha256 (and location) of the
+    exact scales pytree the artifact was built with, and the re-persisted
+    scales file must match that hash — a served artifact is traceable to
+    its calibration run (ISSUE 5 satellite fix)."""
+    from real_time_helmet_detection_tpu.export import (export_predict,
+                                                       load_exported)
+
+    out = str(tmp_path / "export_int8")
+    cfg = tiny_cfg(save_path=out, infer_dtype="int8", calib_batches=2,
+                   conf_th=0.0)
+    bin_path, _ = export_predict(cfg, out_dir=out)
+    meta = json.load(open(os.path.join(out, "meta.json")))
+    assert meta["infer_dtype"] == "int8"
+    assert meta["quant_scales_sha256"]
+    scales_file = os.path.join(out, meta["quant_scales_path"])
+    assert os.path.exists(scales_file)
+    rec = json.load(open(scales_file))
+    assert rec["sha256"] == meta["quant_scales_sha256"]
+    # the serialized int8 program must actually run and keep its contract
+    img = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (1, 64, 64, 3)).astype(np.float32))
+    boxes, classes, scores, valid = load_exported(bin_path).call(img)
+    assert np.asarray(boxes).shape == (1, cfg.num_stack * cfg.topk, 4)
+    assert np.isfinite(np.asarray(scores)).all()
+
+
+def test_export_bf16_metadata_records_no_scales(tmp_path):
+    from real_time_helmet_detection_tpu.export import export_predict
+
+    out = str(tmp_path / "export_f")
+    export_predict(tiny_cfg(save_path=out), out_dir=out)
+    meta = json.load(open(os.path.join(out, "meta.json")))
+    assert meta["infer_dtype"] == "bf16"
+    assert meta["quant_scales_sha256"] is None
