@@ -238,7 +238,7 @@ def find_last_tpu_result(repo_root: str | None = None) -> dict | None:
             "latency_ms_b1", "train_img_per_sec_chip", "train_step_ms",
             "mfu_train", "mfu_fwd", "device_kind", "peak_pallas_us",
             "peak_xla_us", "pallas_matches_xla", "infer_dtype", "int8_fps",
-            "int8_vs_bf16")
+            "int8_vs_bf16", "recompile_count", "loadavg")
     out.update({k: rec[k] for k in keep if k in rec})
     return out
 
@@ -368,6 +368,26 @@ def _bench(out: dict, hb) -> None:
     log("backend up: %d x %s (%s)" % (len(devs), device_kind, platform))
     hb.beat("backend up (%s)" % platform)
 
+    # Flight recorder (ISSUE 6): span tracing when $OBS_SPAN_LOG is set
+    # (the job supervisor exports it per round), a recompile counter
+    # always, and the host-context sample whose loadavg rides the JSON
+    # line — cross-run wall-clock deltas finally carry their confounders
+    # (this box's speed varies ~2x over hours, CLAUDE.md).
+    from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
+    from real_time_helmet_detection_tpu.obs.telemetry import \
+        install_recompile_counter
+    tracer = maybe_tracer()
+    recompiles = install_recompile_counter(tracer)
+    ctx = tracer.context(phase="bench", platform=platform)
+    out["loadavg"] = ctx.get("loadavg")
+    out["span_log"] = tracer.path
+    if tracer.enabled:
+        log("span log -> %s" % tracer.path)
+
+    def _finalize_obs() -> None:
+        """Late fields for the ONE JSON line (both print sites)."""
+        out["recompile_count"] = recompiles.count
+
     peak = DEFAULT_PEAK
     peak_known = False
     for key, val in PEAK_BF16.items():
@@ -459,8 +479,9 @@ def _bench(out: dict, hb) -> None:
     try:
         images = jnp.asarray(rng.standard_normal(
             (batch, imsize, imsize, 3)).astype(np.float32))
-        compiled = make_predict_chain(predict, n_inf).lower(
-            variables, images).compile()
+        with tracer.span("bench:inference-compile", batch=batch):
+            compiled = make_predict_chain(predict, n_inf).lower(
+                variables, images).compile()
         chain_flops = flops_of(compiled)
         images, s = compiled(variables, images)  # warmup (donates images;
         np.asarray(s)  # the returned carry is the next call's input)
@@ -553,8 +574,9 @@ def _bench(out: dict, hb) -> None:
             train_batch, imsize, pos_rate=0.01))
 
         train_n = make_scanned_train_fn(body, n_train)
-        tcompiled = jax.jit(train_n, donate_argnums=(0,)).lower(
-            state, *arrs).compile()
+        with tracer.span("bench:train-compile", batch=train_batch):
+            tcompiled = jax.jit(train_n, donate_argnums=(0,)).lower(
+                state, *arrs).compile()
         train_flops = flops_of(tcompiled)
         train_bytes = bytes_of(tcompiled)  # scan body counted once -> /step
         try:
@@ -670,6 +692,7 @@ def _bench(out: dict, hb) -> None:
             out["pallas_timeout"] = True
             log("pallas section still running at timeout; reporting "
                 "without it")
+            _finalize_obs()
             print(json.dumps(out))
             sys.stdout.flush()
             from real_time_helmet_detection_tpu.runtime import \
@@ -684,6 +707,8 @@ def _bench(out: dict, hb) -> None:
             os._exit(0)
         out.update(pallas_out)
 
+    _finalize_obs()
+    tracer.close()
     print(json.dumps(out))
 
 

@@ -33,6 +33,16 @@ analogue is manual code review, ref /root/reference/README.md:1):
                           citation (`ref <file:line>` / `/root/reference`
                           path / an explicit no-analogue statement): the
                           parity-checkability convention (CLAUDE.md).
+* `raw-span-timing`     — hand-rolled span timing (`time.X() - t0`) in a
+                          chip-path script (one that acquires a backend):
+                          ad-hoc wall-clock spans are invisible to the
+                          flight recorder (obs/spans.py) and keep
+                          re-growing the per-call-timing folklore. Use
+                          `obs.spans.SpanTracer.span(...)` — it always
+                          measures (read `sp.dur_s` for your JSON
+                          artifact) and lands in the round's span log when
+                          $OBS_SPAN_LOG is set. The sanctioned bench
+                          timing harness is allowlisted.
 
 Suppression: a `# graftlint: off=<rule>[,<rule>]` comment anywhere inside
 the flagged node's line span disables that rule there — every suppression
@@ -78,6 +88,14 @@ DEVICE_GET_LOOP_ALLOW = {
 RAW_WRITE_ALLOW = {
     # the atomic-write implementation itself
     "real_time_helmet_detection_tpu/utils.py",
+}
+RAW_SPAN_ALLOW = {
+    # the sanctioned timing harness (bench.py module docstring): its
+    # wall-clock arithmetic IS the documented methodology — scan inside
+    # one program, scalar fetch, subtract measured dispatch overhead
+    "bench.py::measure_dispatch_overhead",
+    "bench.py::timed_fetch",
+    "bench.py::chain_timed_fetch",
 }
 
 _REF_PATTERNS = (
@@ -341,9 +359,67 @@ def rule_missing_ref_citation(tree, lines, relpath) -> List[Finding]:
                 "reference has no analogue (CLAUDE.md convention)")]
 
 
+def _acquires_backend(tree: ast.Module) -> bool:
+    """Does this module take the device claim (the queue-bypass rule's
+    definition of a chip-path script)?"""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name.endswith("acquire_backend") or name == "jax.devices":
+                return True
+    return False
+
+
+def rule_raw_span_timing(tree, lines, relpath) -> List[Finding]:
+    """`time.X() - <start>` span arithmetic in a chip-path script: route
+    it through obs.spans.SpanTracer (ISSUE 6 satellite). Scope mirrors
+    queue-bypass — scripts/ + the root chip scripts — narrowed to modules
+    that actually acquire a backend; the flight recorder is about chip
+    evidence, not generic CLI stopwatches."""
+    if not (relpath in QUEUE_RULE_FILES
+            or any(relpath.startswith(p) for p in QUEUE_RULE_PREFIXES)):
+        return []
+    if not _acquires_backend(tree):
+        return []
+    out = []
+    for qual, node, body in _iter_scopes(tree):
+        if "%s::%s" % (relpath, qual) in RAW_SPAN_ALLOW \
+                or "%s::%s" % (os.path.basename(relpath), qual) \
+                in RAW_SPAN_ALLOW:
+            continue
+        stack: List[ast.AST] = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+            if not (isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub)):
+                continue
+            left = n.left
+            if not isinstance(left, ast.Call):
+                continue
+            name = _call_name(left)
+            if not (name.startswith("time.")
+                    and name.split(".")[-1] in _TIMING_FNS):
+                continue
+            if _suppressed("raw-span-timing", lines, n.lineno,
+                           getattr(n, "end_lineno", n.lineno)):
+                continue
+            out.append(Finding(
+                rule="ast/raw-span-timing", path=relpath, line=n.lineno,
+                context=qual,
+                message="hand-rolled span timing (time.%s() - start) in a "
+                        "chip-path script is invisible to the flight "
+                        "recorder — use obs.spans.SpanTracer.span(...) "
+                        "(sp.dur_s carries the value; the record lands in "
+                        "the round's span log)" % name.split(".")[-1]))
+    return out
+
+
 RULES = (rule_per_call_timing, rule_queue_bypass, rule_env_platform_write,
          rule_raw_artifact_write, rule_device_get_in_loop,
-         rule_missing_ref_citation)
+         rule_missing_ref_citation, rule_raw_span_timing)
 
 
 # ---------------------------------------------------------------------------

@@ -218,6 +218,19 @@ class Config:
     # transient backend error at that step, to exercise --auto-resume
     save_path: str = "./WEIGHTS/"
     profile: bool = False         # jax.profiler trace of early train steps
+    telemetry: bool = False       # in-jit step telemetry (obs/telemetry.py):
+    # grad/update/param global norms computed INSIDE the jitted step and
+    # fetched in the SAME D2H as the loss scalars (deferred flush / the
+    # scanned telemetry ring) — zero extra tunnel round trips. Off (the
+    # default) traces the exact pre-telemetry program: loss bit-identical
+    # (tested). The reference has no analogue (it logs only its four loss
+    # scalars, ref train.py:104-140).
+    span_log: str = ""            # flight-recorder span log (obs/spans.py):
+    # path to a JSONL file recording loader-wait/h2d/dispatch/fetch/
+    # checkpoint/compile spans + host-context samples in train and eval.
+    # "" = $OBS_SPAN_LOG when exported (the job supervisor sets it for
+    # every queued job), else disabled (zero cost). Read it back with
+    # scripts/obs_report.py.
     summary: bool = True          # print a layer table at train start on
     # the chief (≡ reference torchsummary on rank 0, ref train.py:50;
     # --no-summary disables). Shape inference only — no device compute.

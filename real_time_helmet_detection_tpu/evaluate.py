@@ -138,6 +138,12 @@ def evaluate(cfg: Config) -> Dict:
     from .parallel import init_distributed
     init_distributed(cfg)
     rank, world = jax.process_index(), jax.process_count()
+    # Flight recorder (obs/): the eval loop's phases land in the span log
+    # when --span-log/$OBS_SPAN_LOG is set — disabled it costs nothing.
+    from .obs.spans import maybe_tracer
+    tracer = maybe_tracer(cfg.span_log or None)
+    if tracer.enabled:
+        tracer.context(phase="evaluate", rank=rank)
     model, variables = load_eval_state(cfg)
     # Multi-device eval: shard the batch over a data mesh when the batch
     # divides the device count (single-host; the reference's eval is
@@ -180,8 +186,9 @@ def evaluate(cfg: Config) -> Dict:
     # jitted dispatch per batch fetching only per-layer scalars).
     quant_scales = None
     if cfg.infer_dtype == "int8":
-        quant_scales = _eval_quant_scales(cfg, variables, loader,
-                                          chief=rank == 0)
+        with tracer.span("calibrate", batches=cfg.calib_batches):
+            quant_scales = _eval_quant_scales(cfg, variables, loader,
+                                              chief=rank == 0)
     predict = make_predict_fn(model, cfg, normalize=cfg.pretrained,
                               mesh=mesh, quant_scales=quant_scales)
 
@@ -257,7 +264,7 @@ def evaluate(cfg: Config) -> Dict:
             return (jax.device_put(images, sharding)
                     if sharding is not None else jax.device_put(images))
 
-        iterator = DevicePrefetcher(iterator, stage,
+        iterator = DevicePrefetcher(iterator, tracer.wrap("h2d", stage),
                                     depth=cfg.device_prefetch)
 
     # Software-pipelined loop (same shape as the async train loop): batch
@@ -268,7 +275,10 @@ def evaluate(cfg: Config) -> Dict:
     pending = None  # (un-fetched device dets, infos of that batch)
     tic = time.time()
     for i, item in enumerate(iterator):
-        meters["data"].update(time.time() - tic)
+        data_t = time.time() - tic
+        meters["data"].update(data_t)
+        if tracer.enabled:
+            tracer.record("loader-wait", data_t, it=i)
         t0 = time.time()
         if isinstance(item, StagedBatch):
             images, infos = item.arrays, item.host[1]
@@ -279,13 +289,19 @@ def evaluate(cfg: Config) -> Dict:
             # and re-distribute
             images, infos = item
         dets_dev = predict(variables, images)  # async dispatch
-        meters["dispatch"].update(time.time() - t0)
+        dispatch_t = time.time() - t0
+        meters["dispatch"].update(dispatch_t)
+        if tracer.enabled:
+            tracer.record("dispatch", dispatch_t, it=i)
         if pending is not None:
             t0 = time.time()
             consume(jax.device_get(pending[0]), pending[1])
             # includes the device_get wait, i.e. any device time not hidden
             # behind the host work
-            meters["consume"].update(time.time() - t0)
+            consume_t = time.time() - t0
+            meters["consume"].update(consume_t)
+            if tracer.enabled:
+                tracer.record("fetch", consume_t, it=i)
         pending = (dets_dev, infos)
 
         if i % max(1, cfg.print_interval // 10) == 0:
@@ -301,6 +317,7 @@ def evaluate(cfg: Config) -> Dict:
         meters["consume"].update(time.time() - t0)
     if hasattr(loader, "close"):
         loader.close()  # reap workers, unlink shared-memory slots
+    tracer.close()
 
     if world > 1:
         m = _score_multihost(cfg, dataset, results, txt_dir, rank, world)

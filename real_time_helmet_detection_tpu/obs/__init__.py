@@ -1,0 +1,21 @@
+"""obs/ — the flight recorder: step telemetry, span tracing, host context.
+
+Three coordinated parts (ISSUE 6; the reference has no observability at
+all — its loop prints averaged meters, ref train.py:140-160):
+
+* `obs.telemetry` (jax): in-jit step scalars (grad/update/param norms +
+  per-component losses) and the fixed-shape telemetry ring carried through
+  the scanned train fn — fetched in the SAME single D2H as the loss.
+* `obs.spans` (stdlib): crash-safe JSONL span tracer for host-side phases
+  (loader-wait/h2d/dispatch/fetch/checkpoint/compile/...).
+* `obs.context` (stdlib): loadavg + relay-liveness sampler.
+
+This __init__ stays STDLIB-ONLY (spans/context re-exports): runtime/ —
+which must never build the ML stack — imports `obs.spans` for
+beats-become-spans mirroring. Import `obs.telemetry` directly where jax
+is already loaded (train.py, bench.py).
+"""
+
+from .context import sample_context  # noqa: F401
+from .spans import (OBS_SPAN_ENV, SPAN_SCHEMA, Span,  # noqa: F401
+                    SpanTracer, maybe_tracer, read_spans)

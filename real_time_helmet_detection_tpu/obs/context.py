@@ -1,0 +1,45 @@
+"""Host-context sampler: the confounders behind cross-run wall-clock deltas.
+
+The reference has no analogue (it never measures anything but its own
+meters, ref train.py:92-140). This exists because two documented failure
+classes keep polluting the repo's timing evidence (CLAUDE.md):
+
+* the shared box's effective speed varies ~2x over hours (identical train
+  steps measured 3.1-6.8 s) — so every timing artifact should carry the
+  loadavg it was measured under;
+* the TPU relay's local end (`/root/.relay.py` + listeners on
+  127.0.0.1:8082-8117) can die mid-round — a "slow" span during an outage
+  is not slow code.
+
+`sample_context()` is stdlib-only and never raises: it reads /proc the
+same way the job supervisor's triage probe does (reusing
+runtime/supervisor.py's probes), so one definition of "relay alive" serves
+both the queue and the flight recorder.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def sample_context() -> dict:
+    """One best-effort snapshot: {loadavg, ncpu, relay_process,
+    relay_listening}. Missing facilities degrade to None, never raise —
+    a sampler that can kill the run it is observing is worse than none."""
+    sample: dict = {"ncpu": os.cpu_count()}
+    try:
+        la = os.getloadavg()
+        sample["loadavg"] = [round(x, 2) for x in la]
+    except OSError:
+        sample["loadavg"] = None
+    try:
+        # lazy import: obs/ must stay importable without triggering the
+        # runtime package (and vice versa — heartbeat imports obs.spans)
+        from ..runtime.supervisor import (_relay_port_listening,
+                                          _relay_process_alive)
+        sample["relay_process"] = _relay_process_alive()
+        sample["relay_listening"] = _relay_port_listening()
+    except Exception:  # noqa: BLE001 — sampling is strictly best-effort
+        sample["relay_process"] = None
+        sample["relay_listening"] = None
+    return sample

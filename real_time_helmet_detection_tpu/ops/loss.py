@@ -117,16 +117,37 @@ class LossLog:
 
     Appended once per optimization step from device scalars; serialized into
     checkpoints like the reference does (ref train.py:82).
+
+    On-disk schema is VERSIONED (ISSUE 6 satellite): `state_dict()` tags
+    the key->list dict with `"schema": "loss-log-v2"` and carries the base
+    loss keys plus the in-jit telemetry norms (`--telemetry`: grad/update/
+    param norm, obs/telemetry.py — their lists stay empty when telemetry
+    is off). The constructor also reads a bare v1 sidecar (the pre-PR
+    untagged dict of the four loss keys), so every existing checkpoint's
+    loss_log.json keeps restoring (regression-pinned against the
+    checked-in tests/fixtures/loss_log_v1.json).
     """
 
     KEYS = ("hm", "offset", "size", "total")
+    TELEMETRY_KEYS = ("grad_norm", "update_norm", "param_norm")
+    SCHEMA = "loss-log-v2"
 
     def __init__(self, log: Mapping[str, list] | None = None):
-        self.log = {k: list((log or {}).get(k, [])) for k in self.KEYS}
+        schema = (log or {}).get("schema", None)
+        if schema is not None and schema != self.SCHEMA:
+            raise ValueError("unknown loss-log schema %r (this build reads "
+                             "v1 sidecars and %s)" % (schema, self.SCHEMA))
+        self.log = {k: list((log or {}).get(k, []))
+                    for k in self.KEYS + self.TELEMETRY_KEYS}
 
     def append(self, losses: Mapping[str, float]) -> None:
         for k in self.KEYS:
             self.log[k].append(float(losses[k]))
+        # telemetry scalars ride along only when the step produced them
+        # (--telemetry); a v1-shaped losses dict appends exactly as before
+        for k in self.TELEMETRY_KEYS:
+            if k in losses:
+                self.log[k].append(float(losses[k]))
 
     def get_log(self, length: int = 100) -> str:
         parts = []
@@ -136,5 +157,7 @@ class LossLog:
             parts.append("%s: %5.2f" % (key, avg))
         return ", ".join(parts)
 
-    def state_dict(self) -> Dict[str, list]:
-        return {k: list(v) for k, v in self.log.items()}
+    def state_dict(self) -> Dict:
+        out: Dict = {"schema": self.SCHEMA}
+        out.update({k: list(v) for k, v in self.log.items()})
+        return out

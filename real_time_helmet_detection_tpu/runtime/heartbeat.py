@@ -53,12 +53,23 @@ def _atomic_write_text(path: str, text: str) -> None:
 class FileHeartbeat:
     """Per-job heartbeat file: `beat(label)` atomically rewrites
     `{"t": wall, "pid": ..., "label": ...}`; the file's mtime is what the
-    supervisor watches (content is for the human reading a postmortem)."""
+    supervisor watches (content is for the human reading a postmortem).
 
-    def __init__(self, path: str):
+    Beats also land as `heartbeat` EVENTS in the flight-recorder span log
+    when one is configured ($OBS_SPAN_LOG — obs/spans.py, stdlib like this
+    module): the heartbeat file keeps only the LAST beat, the span log
+    keeps them all, so a postmortem can see the job's whole progress
+    timeline, not just where it died (ISSUE 6)."""
+
+    def __init__(self, path: str, tracer=None):
         self.path = path
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
+        if tracer is None:
+            # lazy sibling import: obs.spans is stdlib-only by contract
+            from ..obs.spans import maybe_tracer
+            tracer = maybe_tracer()
+        self._tracer = tracer
 
     def beat(self, label: str = "beat") -> None:
         try:
@@ -67,6 +78,8 @@ class FileHeartbeat:
         except OSError:
             # liveness reporting must never kill the job doing the work
             pass
+        if getattr(self._tracer, "enabled", False):
+            self._tracer.event("heartbeat", label=str(label))
 
 
 class _NoopHeartbeat:

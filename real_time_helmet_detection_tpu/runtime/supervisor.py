@@ -294,6 +294,14 @@ class Supervisor:
         env.update(js.spec.env)
         env[HEARTBEAT_ENV] = self.spool.heartbeat_path(js.spec.job)
         env[STATUS_ENV] = self.spool.status_path(js.spec.job, js.attempt)
+        # Flight recorder (ISSUE 6): every queued job writes its spans into
+        # the round's obs/ log next to the queue dir, so obs_report.py can
+        # join the journal with what each job was actually doing. An
+        # explicit $OBS_SPAN_LOG (operator or job spec env) wins.
+        env.setdefault(
+            "OBS_SPAN_LOG",
+            os.path.join(os.path.dirname(self.spool.root), "obs",
+                         "spans.jsonl"))
         return env
 
     def _run_job(self, js: JobState) -> None:

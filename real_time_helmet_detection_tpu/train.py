@@ -148,6 +148,24 @@ def loss_fn(params, batch_stats, model, images, gt_heat, gt_off, gt_wh, mask,
     return totals["total"], (mutated.get("batch_stats", batch_stats), totals)
 
 
+def _maybe_telemetry(cfg: Config, losses, grads, old_params,
+                     new_state: TrainState):
+    """Attach the in-jit telemetry scalars (grad/update/param global norms,
+    obs/telemetry.py) to the step's losses dict when `--telemetry` is on.
+
+    Off (the default) this is an identity at TRACE time — the compiled
+    step is the exact pre-telemetry program and the loss is bit-identical
+    (pinned by tests/test_obs.py). On, the scalars ride the SAME fetch as
+    the loss scalars (the deferred flush / the scanned ring): zero extra
+    D2H, zero extra tunnel round trips."""
+    if not getattr(cfg, "telemetry", False):
+        return losses
+    from .obs.telemetry import telemetry_scalars
+    out = dict(losses)
+    out.update(telemetry_scalars(grads, old_params, new_state.params))
+    return out
+
+
 def _optimizer_update(state: TrainState, tx, cfg: Config, grads,
                       batch_stats) -> TrainState:
     """Shared update tail of every train-step body: optimizer step + EMA
@@ -176,12 +194,15 @@ def make_train_step_body(model, tx, cfg: Config):
         (_, (batch_stats, losses)), grads = grad_fn(
             state.params, state.batch_stats, model, images, gt_heat, gt_off,
             gt_wh, mask, cfg)
-        return _optimizer_update(state, tx, cfg, grads, batch_stats), losses
+        new_state = _optimizer_update(state, tx, cfg, grads, batch_stats)
+        return new_state, _maybe_telemetry(cfg, losses, grads, state.params,
+                                           new_state)
 
     return step
 
 
-def make_scanned_train_fn(body, n: int):
+def make_scanned_train_fn(body, n: int, telemetry: bool = False,
+                          ring_capacity: int = 64):
     """`n` sequential train steps inside ONE XLA program (`lax.scan` over a
     `make_train_step_body` step), returning (final TrainState, last total
     loss).
@@ -198,13 +219,44 @@ def make_scanned_train_fn(body, n: int):
     elided, and XLA emits no "Some donated buffers were not usable"
     warning. Callers must time by fetching ONLY the scalar loss
     (`compiled(...)[1]`) — fetching the state would drag the whole model
-    through the (slow) D2H transport and into the measurement."""
+    through the (slow) D2H transport and into the measurement.
+
+    `telemetry=True` (flight recorder, ISSUE 6; requires a body built from
+    a `--telemetry` cfg) additionally threads a FIXED-SHAPE telemetry ring
+    (obs/telemetry.py) through the scan carry: per-step loss components +
+    grad/update/param norms land in a (ring_capacity, K) f32 buffer that
+    returns NEXT TO the loss scalar — out[1] becomes (last_total, ring),
+    fetched in the SAME single D2H (a few KiB; decode on host with
+    `ring_to_host`). Telemetry off keeps the exact pre-PR signature and
+    program."""
+    if not telemetry:
+        def train_n(state, images, heat, off, wh, mask):
+            def sbody(st, _):
+                st, losses = body(st, images, heat, off, wh, mask)
+                return st, losses["total"]
+            st, totals = jax.lax.scan(sbody, state, None, length=n)
+            return st, totals[-1]
+        return train_n
+
+    from .obs.telemetry import SCAN_TELEMETRY_KEYS, ring_init, ring_push
+
     def train_n(state, images, heat, off, wh, mask):
-        def sbody(st, _):
+        def sbody(carry, _):
+            st, ring = carry
             st, losses = body(st, images, heat, off, wh, mask)
-            return st, losses["total"]
-        st, totals = jax.lax.scan(sbody, state, None, length=n)
-        return st, totals[-1]
+            missing = [k for k in SCAN_TELEMETRY_KEYS if k not in losses]
+            if missing:
+                raise ValueError(
+                    "make_scanned_train_fn(telemetry=True) needs a step "
+                    "body built with cfg.telemetry=True; losses dict is "
+                    "missing %s" % missing)
+            ring = ring_push(ring, [losses[k] for k in SCAN_TELEMETRY_KEYS])
+            return (st, ring), losses["total"]
+        carry0 = (state, ring_init(ring_capacity))
+        (st, ring), totals = jax.lax.scan(sbody, carry0, None, length=n)
+        return st, (totals[-1], ring)
+
+    train_n.telemetry_keys = SCAN_TELEMETRY_KEYS
     return train_n
 
 
@@ -295,7 +347,9 @@ def make_device_step_body(model, tx, cfg: Config, target: int):
         (_, (batch_stats, losses)), grads = grad_fn(
             state.params, state.batch_stats, model, img, heat, off, wh, mask,
             cfg)
-        return _optimizer_update(state, tx, cfg, grads, batch_stats), losses
+        new_state = _optimizer_update(state, tx, cfg, grads, batch_stats)
+        return new_state, _maybe_telemetry(cfg, losses, grads, state.params,
+                                           new_state)
 
     return step
 
@@ -825,8 +879,19 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
                 is_chief: bool = True, snapshot_fn=None,
                 profile_this_epoch: bool = False,
                 epoch_base_step: int = 0, watchdog=None,
-                injector: Optional[FaultInjector] = None) -> TrainState:
-    """One epoch of the hot loop (≡ ref train.py:86-162 `train_step`)."""
+                injector: Optional[FaultInjector] = None,
+                tracer=None) -> TrainState:
+    """One epoch of the hot loop (≡ ref train.py:86-162 `train_step`).
+
+    `tracer` (obs/spans.py, optional): when span tracing is enabled the
+    loop's phases land in the flight-recorder log — `loader-wait` (host
+    batch production), `step` (async dispatch + any un-hidden device
+    wait), `fetch` (the deferred loss flush, i.e. the real completion
+    barrier) and `h2d` (the prefetcher's sharded device_put) — so a slow
+    epoch is attributable after the fact instead of folklore."""
+    from .obs.spans import SpanTracer
+    if tracer is None:
+        tracer = SpanTracer(None)  # disabled: wrap() is identity
     # segment meters are host-visible averages made honest by the
     # periodic flush barrier (see `pending` below), not per-call device
     # timing — bench.py owns that: graftlint: off=per-call-timing
@@ -845,7 +910,14 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
     pending: list = []
 
     def flush_losses():
-        for fetched in jax.device_get(pending):
+        if not pending:
+            return
+        # ONE device_get for the whole interval — the span around it is
+        # the loop's true completion barrier (any device time the host
+        # work failed to hide shows up here, not in `step`)
+        with tracer.span("fetch", steps=len(pending)):
+            fetched_all = jax.device_get(pending)
+        for fetched in fetched_all:
             loss_log.append(fetched)
         pending.clear()
 
@@ -855,7 +927,8 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
         # the next `device_prefetch` batches while the current step runs.
         # The cached input path has no stage (its wire is B int32 indices).
         from .data import DevicePrefetcher
-        iterator = DevicePrefetcher(loader, step_runner.stage,
+        iterator = DevicePrefetcher(loader,
+                                    tracer.wrap("h2d", step_runner.stage),
                                     depth=cfg.device_prefetch)
     from .data import StagedBatch
     tic = time.time()
@@ -864,6 +937,8 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
             injector.maybe_fire(epoch, i)
         data_t = time.time() - tic
         meters["data"].update(data_t)
+        if tracer.enabled:
+            tracer.record("loader-wait", data_t, epoch=epoch, it=i)
 
         if profile_this_epoch and is_chief and i == 2:
             # steps 0-1 include compiles; trace a few steady-state steps
@@ -880,7 +955,12 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
             # steps; the flush is where the host truly observes completion
             if watchdog is not None:
                 watchdog.beat("epoch %d iter %d (flushed)" % (epoch, i))
-        meters["step"].update(time.time() - tic - data_t)
+        step_t = time.time() - tic - data_t
+        meters["step"].update(step_t)
+        if tracer.enabled:
+            # async-dispatch time (+ the flush barrier's device wait when
+            # this was a flush iteration) — same semantics as the meter
+            tracer.record("step", step_t, epoch=epoch, it=i)
 
         if profiling and i >= 7:
             flush_losses()  # completion barrier: the trace must contain
@@ -1049,6 +1129,19 @@ def train(cfg: Config) -> TrainState:
     # When running under scripts/tpu_queue.py the supervisor exports a
     # heartbeat path: the watchdog's beats double as the job's liveness
     # signal, so a wedged step trips the supervisor's kill-salvage too.
+    # Flight recorder (obs/): span tracing is on when --span-log names a
+    # path (or $OBS_SPAN_LOG is exported, e.g. by the job supervisor);
+    # disabled it costs nothing. The recompile counter turns "why was this
+    # epoch slow" answerable when a shape change silently retraced.
+    from .obs.spans import maybe_tracer
+    tracer = maybe_tracer(cfg.span_log or None)
+    recompiles = None
+    if tracer.enabled:
+        from .obs.telemetry import install_recompile_counter
+        recompiles = install_recompile_counter(tracer)
+        if is_chief:
+            print("%s: span log -> %s" % (timestamp(), tracer.path),
+                  flush=True)
     watchdog = HangWatchdog(cfg.hang_warn_seconds,
                             beat_file=os.environ.get(HEARTBEAT_ENV))
     if hasattr(loader, "worker_status"):
@@ -1064,12 +1157,17 @@ def train(cfg: Config) -> TrainState:
     try:
         while epoch < cfg.end_epoch:
             try:
+                if tracer.enabled:
+                    # per-epoch confounder sample: the shared box's load
+                    # varies ~2x over hours and the relay can die mid-run
+                    # (CLAUDE.md) — wall-clock deltas need this context
+                    tracer.context(epoch=epoch)
                 state = train_epoch(
                     cfg, epoch, loader, runner, state, mesh,
                     loss_log, is_chief, snapshot_fn,
                     profile_this_epoch=(cfg.profile and epoch == start_epoch),
                     epoch_base_step=epoch * steps_per_epoch,
-                    watchdog=watchdog, injector=injector)
+                    watchdog=watchdog, injector=injector, tracer=tracer)
                 if epoch_flush is not None and int(jax.device_get(
                         state.opt_state.mini_step)):
                     # partial accumulation window at epoch end: flush it
@@ -1093,8 +1191,9 @@ def train(cfg: Config) -> TrainState:
                     # pause is the best local approximation.)
                     watchdog.pause("epoch %d boundary (checkpoint)" % epoch)
                     if is_chief:
-                        path = writer.save(cfg.save_path, epoch, state,
-                                           loss_log)
+                        with tracer.span("checkpoint", epoch=epoch):
+                            path = writer.save(cfg.save_path, epoch, state,
+                                               loss_log)
                         run_ckpts.append(path)
                         print("%s: epoch %d checkpoint -> %s"
                               % (timestamp(), epoch, path), flush=True)
@@ -1219,4 +1318,8 @@ def train(cfg: Config) -> TrainState:
         watchdog.stop()
         if hasattr(loader, "close"):
             loader.close()  # reap workers, unlink shared-memory slots
+        if tracer.enabled and recompiles is not None:
+            tracer.event("recompile-total", count=recompiles.count,
+                         total_s=round(recompiles.total_s, 3))
+        tracer.close()
     return state

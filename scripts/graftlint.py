@@ -169,6 +169,28 @@ AST_FIXTURES = {
         '"""A cited module (ref train.py:86) with provenance."""\n'
         "X = 1\n",
     ),
+    "raw-span-timing": (
+        # a chip-path script (acquires a backend) timing a span by hand
+        "import time\n"
+        "from bench import acquire_backend\n"
+        "from real_time_helmet_detection_tpu.runtime import run_as_job\n"
+        "def main():\n"
+        "    jax, devs = acquire_backend()\n"
+        "    t0 = time.time()\n"
+        "    compiled = build()\n"
+        "    rec = {'compile_s': time.time() - t0}\n"
+        "run_as_job(main)\n",
+        # the same script routed through the flight recorder
+        "from bench import acquire_backend\n"
+        "from real_time_helmet_detection_tpu.obs.spans import maybe_tracer\n"
+        "from real_time_helmet_detection_tpu.runtime import run_as_job\n"
+        "def main():\n"
+        "    jax, devs = acquire_backend()\n"
+        "    with maybe_tracer().span('compile') as sp:\n"
+        "        compiled = build()\n"
+        "    rec = {'compile_s': sp.dur_s}\n"
+        "run_as_job(main)\n",
+    ),
 }
 
 

@@ -1,0 +1,477 @@
+"""obs_report — the per-round "what actually happened" report.
+
+The reference repo has no observability tooling at all (its training loop
+prints averaged meters and exits, ref train.py:140-160); this joiner is
+new capability (ISSUE 6). It fuses the round's four evidence streams into
+ONE artifact:
+
+* the flight-recorder span log(s)  (obs/spans.py JSONL: loader-wait/h2d/
+  dispatch/fetch/checkpoint/compile spans, heartbeat events, host-context
+  samples with loadavg + relay liveness),
+* the tpu_queue job journal        (artifacts/<round>/queue/jobs.jsonl:
+  per-job state transitions, attempts, salvages),
+* bench JSON lines                 (BENCH_*_local.json under the round),
+* loss_log.json sidecars           (loss-log-v1 or -v2, --loss-log PATH).
+
+Output: `artifacts/<round>/obs/report.md` (human) + `report.json` and ONE
+JSON line on stdout (machine), schema `obs-report-v1`. Everything is
+read-only over its inputs (the queue journal is parsed tolerantly, torn
+tails dropped, never repaired in place) and CPU-only — run it after any
+round, chip or not.
+
+Usage:
+
+    python scripts/obs_report.py                   # current $GRAFT_ROUND
+    python scripts/obs_report.py --round r07 \
+        --loss-log WEIGHTS/check_point_45/loss_log.json
+    python scripts/obs_report.py --selfcheck       # seeded fixtures ->
+                                                   # report invariants (~s)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import graft_round  # noqa: E402 — one shared round default
+from real_time_helmet_detection_tpu.obs.spans import (  # noqa: E402
+    maybe_tracer, read_spans)
+from real_time_helmet_detection_tpu.utils import (  # noqa: E402
+    atomic_write_bytes, save_json)
+
+SCHEMA = "obs-report-v1"
+
+
+def log(msg: str) -> None:
+    print("[obs_report] %s" % msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# per-source loaders/summarizers (each tolerant: a missing/torn source
+# nulls its section instead of killing the report)
+
+
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def summarize_spans(paths: List[str]) -> Dict:
+    """Roll every span log up into per-name duration stats + event counts
+    + the context-sample digest (loadavg spread, relay incidents)."""
+    spans: Dict[str, List[float]] = {}
+    events: Dict[str, int] = {}
+    contexts: List[dict] = []
+    total_records = 0
+    for path in paths:
+        for rec in read_spans(path):
+            total_records += 1
+            kind = rec.get("kind")
+            if kind == "span" and isinstance(rec.get("dur_s"), (int, float)):
+                spans.setdefault(rec.get("name", "?"), []).append(
+                    float(rec["dur_s"]))
+            elif kind == "event":
+                events[rec.get("name", "?")] = \
+                    events.get(rec.get("name", "?"), 0) + 1
+            elif kind == "context":
+                contexts.append(rec.get("sample", {}))
+    by_name = {}
+    for name, durs in sorted(spans.items()):
+        s = sorted(durs)
+        by_name[name] = {
+            "count": len(s), "total_s": round(sum(s), 3),
+            "mean_s": round(sum(s) / len(s), 6),
+            "p50_s": round(_pctl(s, 0.50), 6),
+            "p95_s": round(_pctl(s, 0.95), 6),
+            "max_s": round(s[-1], 6),
+        }
+    ctx: Dict = {"samples": len(contexts)}
+    load1 = [c["loadavg"][0] for c in contexts
+             if isinstance(c.get("loadavg"), list) and c["loadavg"]]
+    if load1:
+        ctx["load1_min"] = min(load1)
+        ctx["load1_max"] = max(load1)
+        ctx["load1_mean"] = round(sum(load1) / len(load1), 2)
+    relay_seen = [c for c in contexts
+                  if c.get("relay_process") is not None]
+    if relay_seen:
+        ctx["relay_down_samples"] = sum(
+            1 for c in relay_seen
+            if not (c["relay_process"] and c.get("relay_listening")))
+    # recompile evidence: compile spans (one per backend compile when the
+    # counter's tracer mirror is on) and any recompile-total closing event
+    recompiles = {"compile_spans": by_name.get("compile", {}).get("count", 0),
+                  "compile_total_s": by_name.get("compile",
+                                                 {}).get("total_s", 0.0)}
+    return {"logs": [os.path.relpath(p, REPO) if p.startswith(REPO) else p
+                     for p in paths],
+            "records": total_records, "by_name": by_name,
+            "events": events, "context": ctx, "recompiles": recompiles}
+
+
+def summarize_queue(queue_dir: Optional[str]) -> Optional[Dict]:
+    """Read-only tolerant replay of the job journal: per-job final state,
+    attempts, salvage evidence, queued->terminal wall seconds."""
+    if not queue_dir:
+        return None
+    path = os.path.join(queue_dir, "jobs.jsonl")
+    try:
+        with open(path, "rb") as f:
+            raw_lines = f.read().split(b"\n")
+    except OSError:
+        return None
+    jobs: Dict[str, dict] = {}
+    dropped = 0
+    for i, raw in enumerate(raw_lines):
+        if not raw.strip():
+            continue
+        try:
+            rec = json.loads(raw)
+        except json.JSONDecodeError:
+            dropped += 1  # torn tail (or mid-file damage): report, skip
+            continue
+        kind = rec.get("kind")
+        if kind == "spec":
+            jobs[rec.get("job", "?")] = {
+                "state": "queued", "attempts": 1,
+                "enqueued_t": rec.get("t"), "terminal_t": None,
+                "salvaged_artifacts": 0, "error": None}
+        elif kind == "state":
+            j = jobs.get(rec.get("job"))
+            if j is None:
+                continue
+            j["state"] = rec.get("state", j["state"])
+            j["attempts"] = max(j["attempts"],
+                                int(rec.get("attempt", 1) or 1))
+            if rec.get("state") in ("done", "failed"):
+                j["terminal_t"] = rec.get("t")
+            if rec.get("state") == "salvaged":
+                j["salvaged_artifacts"] += len(
+                    rec.get("salvaged_artifacts", []))
+            if rec.get("error"):
+                j["error"] = str(rec["error"])[:200]
+    for j in jobs.values():
+        if j["enqueued_t"] and j["terminal_t"]:
+            j["wall_s"] = round(j["terminal_t"] - j["enqueued_t"], 1)
+        j.pop("enqueued_t", None)
+        j.pop("terminal_t", None)
+    states = [j["state"] for j in jobs.values()]
+    return {"journal": os.path.relpath(path, REPO)
+            if path.startswith(REPO) else path,
+            "jobs": jobs, "dropped_lines": dropped,
+            "counts": {s: states.count(s) for s in sorted(set(states))}}
+
+
+def summarize_bench(paths: List[str]) -> List[Dict]:
+    """Headline fields from each bench JSON line (the LAST line per file,
+    matching find_last_tpu_result's convention)."""
+    out = []
+    keep = ("metric", "value", "platform", "train_img_per_sec_chip",
+            "mfu_train", "mfu_fwd", "latency_ms_b1", "infer_dtype",
+            "int8_fps", "int8_vs_bf16", "recompile_count", "loadavg",
+            "span_log", "error", "error_class")
+    for path in sorted(paths):
+        try:
+            with open(path) as f:
+                lines = [ln for ln in f.read().splitlines() if ln.strip()]
+            rec = json.loads(lines[-1])
+        except (OSError, json.JSONDecodeError, IndexError):
+            continue
+        row = {"path": os.path.relpath(path, REPO)
+               if path.startswith(REPO) else path}
+        row.update({k: rec[k] for k in keep if k in rec})
+        out.append(row)
+    return out
+
+
+def summarize_loss_log(paths: List[str]) -> List[Dict]:
+    """Per-sidecar digest, reading v1 (untagged) and v2 (schema-tagged)
+    alike — mirrors ops.loss.LossLog's compat contract without importing
+    jax."""
+    out = []
+    for path in sorted(paths):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        schema = d.pop("schema", "loss-log-v1")
+        row: Dict = {"path": os.path.relpath(path, REPO)
+                     if path.startswith(REPO) else path, "schema": schema}
+        for key, vals in d.items():
+            if not isinstance(vals, list) or not vals:
+                continue
+            tail = vals[-min(100, len(vals)):]
+            row[key] = {"n": len(vals), "final": round(float(vals[-1]), 5),
+                        "mean_last100": round(sum(tail) / len(tail), 5)}
+        out.append(row)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# report assembly
+
+
+def build_report(round_name: str, span_paths: List[str],
+                 queue_dir: Optional[str], bench_paths: List[str],
+                 loss_paths: List[str]) -> Dict:
+    return {
+        "schema": SCHEMA, "tool": "obs_report", "round": round_name,
+        "spans": summarize_spans(span_paths),
+        "queue": summarize_queue(queue_dir),
+        "bench": summarize_bench(bench_paths),
+        "loss": summarize_loss_log(loss_paths),
+    }
+
+
+def render_markdown(rep: Dict) -> str:
+    """The human half of the artifact: one table per evidence stream."""
+    lines = ["# Round %s — flight-recorder report" % rep["round"], "",
+             "Schema `%s`; generated by scripts/obs_report.py. Read" %
+             rep["schema"],
+             "docs/ARCHITECTURE.md \"Observability & flight recorder\" "
+             "for the span taxonomy.", ""]
+    sp = rep["spans"]
+    lines += ["## Spans (%d records over %d log(s))"
+              % (sp["records"], len(sp["logs"])), ""]
+    if sp["by_name"]:
+        lines += ["| span | count | total s | mean s | p50 s | p95 s | "
+                  "max s |", "|---|---|---|---|---|---|---|"]
+        for name, s in sp["by_name"].items():
+            lines.append("| %s | %d | %.3f | %.4f | %.4f | %.4f | %.4f |"
+                         % (name, s["count"], s["total_s"], s["mean_s"],
+                            s["p50_s"], s["p95_s"], s["max_s"]))
+    else:
+        lines.append("_no spans recorded_")
+    if sp["events"]:
+        lines += ["", "Events: " + ", ".join(
+            "%s ×%d" % (k, v) for k, v in sorted(sp["events"].items()))]
+    ctx = sp["context"]
+    if ctx.get("samples"):
+        lines += ["", "Context: %d sample(s), load1 %s–%s (mean %s), "
+                  "relay-down samples: %s"
+                  % (ctx["samples"], ctx.get("load1_min", "?"),
+                     ctx.get("load1_max", "?"), ctx.get("load1_mean", "?"),
+                     ctx.get("relay_down_samples", 0))]
+    lines += ["", "Recompiles: %d compile span(s), %.1f s total" % (
+        sp["recompiles"]["compile_spans"],
+        sp["recompiles"]["compile_total_s"]), ""]
+    q = rep["queue"]
+    lines += ["## Queue", ""]
+    if q:
+        lines += ["Journal `%s` — states: %s%s" % (
+            q["journal"],
+            ", ".join("%s ×%d" % (s, n) for s, n in q["counts"].items()),
+            ("; %d torn/damaged line(s) dropped" % q["dropped_lines"]
+             if q["dropped_lines"] else "")), "",
+            "| job | state | attempts | wall s | salvaged | error |",
+            "|---|---|---|---|---|---|"]
+        for name, j in q["jobs"].items():
+            lines.append("| %s | %s | %d | %s | %d | %s |"
+                         % (name, j["state"], j["attempts"],
+                            j.get("wall_s", ""), j["salvaged_artifacts"],
+                            j.get("error") or ""))
+    else:
+        lines.append("_no queue journal found_")
+    lines += ["", "## Bench lines", ""]
+    if rep["bench"]:
+        for row in rep["bench"]:
+            lines.append("- `%s`: %s" % (row["path"], json.dumps(
+                {k: v for k, v in row.items() if k != "path"})))
+    else:
+        lines.append("_no bench artifacts found_")
+    lines += ["", "## Loss logs", ""]
+    if rep["loss"]:
+        for row in rep["loss"]:
+            lines.append("- `%s` (%s): %s" % (row["path"], row["schema"],
+                         json.dumps({k: v for k, v in row.items()
+                                     if k not in ("path", "schema")})))
+    else:
+        lines.append("_no loss logs given (pass --loss-log "
+                     "<ckpt>/loss_log.json)_")
+    return "\n".join(lines) + "\n"
+
+
+def generate(args) -> Dict:
+    round_name = args.round or graft_round()
+    round_dir = os.path.join(REPO, "artifacts", round_name)
+    span_paths = list(args.span_log or [])
+    if not span_paths:
+        span_paths = sorted(glob.glob(os.path.join(round_dir, "obs",
+                                                   "*.jsonl")))
+    queue_dir = args.queue_dir
+    if queue_dir is None:
+        cand = os.path.join(round_dir, "queue")
+        queue_dir = cand if os.path.isdir(cand) else None
+    bench_paths = list(args.bench or [])
+    if not bench_paths:
+        bench_paths = sorted(glob.glob(os.path.join(round_dir,
+                                                    "BENCH_*.json")))
+    rep = build_report(round_name, span_paths, queue_dir, bench_paths,
+                       list(args.loss_log or []))
+    out_dir = args.out or os.path.join(round_dir, "obs")
+    os.makedirs(out_dir, exist_ok=True)
+    save_json(os.path.join(out_dir, "report.json"), rep, indent=1,
+              sort_keys=True)
+    atomic_write_bytes(os.path.join(out_dir, "report.md"),
+                       render_markdown(rep).encode())
+    log("report -> %s/report.{json,md}" % out_dir)
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# selfcheck: seeded fixtures -> report invariants (CI smoke tier)
+
+
+def selfcheck() -> int:
+    """Build one of everything (spans with a torn tail, a queue journal
+    with done/salvaged/failed arcs, a bench line, a v2 loss log), run the
+    full report path into a temp dir, and assert the joins. Mirrors
+    tpu_queue.py/graftlint.py --selfcheck: seconds, CPU-only."""
+    import tempfile
+    failures: List[str] = []
+
+    def check(name, cond):
+        print("selfcheck %-52s %s" % (name, "ok" if cond else "FAIL"),
+              file=sys.stderr, flush=True)
+        if not cond:
+            failures.append(name)
+
+    with tempfile.TemporaryDirectory(prefix="obs_report_selfcheck.") as tmp:
+        # spans: real tracer output + a torn tail the reader must skip
+        span_path = os.path.join(tmp, "obs", "spans.jsonl")
+        tracer = maybe_tracer(span_path)
+        for i in range(4):
+            tracer.record("step", 0.01 * (i + 1), it=i)
+        with tracer.span("checkpoint", epoch=0):
+            pass
+        tracer.event("heartbeat", label="flush 0")
+        tracer.context(phase="selfcheck")
+        tracer.close()
+        with open(span_path, "a") as f:  # graftlint: off=raw-artifact-write
+            f.write('{"kind": "span", "torn')  # kill -9 mid-append twin
+
+        # queue journal: done + salvaged->failed arcs, torn tail
+        qdir = os.path.join(tmp, "queue")
+        os.makedirs(qdir)
+        recs = [
+            {"kind": "spec", "job": "bench", "argv": ["python", "bench.py"],
+             "t": 100.0, "v": 1},
+            {"kind": "state", "job": "bench", "state": "queued", "t": 100.0,
+             "attempt": 1},
+            {"kind": "state", "job": "bench", "state": "running",
+             "t": 101.0, "attempt": 1},
+            {"kind": "state", "job": "bench", "state": "done", "t": 161.0,
+             "attempt": 1},
+            {"kind": "spec", "job": "sweep", "argv": ["python", "s.py"],
+             "t": 102.0, "v": 1},
+            {"kind": "state", "job": "sweep", "state": "queued", "t": 102.0,
+             "attempt": 1},
+            {"kind": "state", "job": "sweep", "state": "running",
+             "t": 103.0, "attempt": 1},
+            {"kind": "state", "job": "sweep", "state": "salvaged",
+             "t": 113.0, "attempt": 1,
+             "salvaged_artifacts": [{"path": "sweep.json"}]},
+            {"kind": "state", "job": "sweep", "state": "failed", "t": 114.0,
+             "attempt": 2, "error": "UNAVAILABLE: injected"},
+            {"kind": "note", "event": "diagnostic"},
+        ]
+        body = "".join(json.dumps(r) + "\n" for r in recs) + '{"kind": "st'
+        atomic_write_bytes(os.path.join(qdir, "jobs.jsonl"), body.encode())
+
+        # one bench line + one v2 loss log
+        bench_path = os.path.join(tmp, "BENCH_rXX_local.json")
+        atomic_write_bytes(bench_path, (json.dumps(
+            {"metric": "inference_fps_512", "value": 1207.7,
+             "platform": "tpu", "mfu_train": 0.53, "recompile_count": 7,
+             "loadavg": [1.0, 1.2, 1.4]}) + "\n").encode())
+        loss_path = os.path.join(tmp, "loss_log.json")
+        atomic_write_bytes(loss_path, json.dumps(
+            {"schema": "loss-log-v2", "hm": [1.0, 0.5], "offset": [1, 0.4],
+             "size": [1, 0.3], "total": [3.0, 1.2],
+             "grad_norm": [30.0, 7.0], "update_norm": [0.8, 0.5],
+             "param_norm": [49.0, 49.1]}).encode())
+
+        ns = argparse.Namespace(round="rXX", span_log=[span_path],
+                                queue_dir=qdir, bench=[bench_path],
+                                loss_log=[loss_path],
+                                out=os.path.join(tmp, "out"))
+        rep = generate(ns)
+
+        check("schema tagged", rep["schema"] == SCHEMA)
+        sp = rep["spans"]
+        check("torn span tail dropped, all real records read",
+              sp["records"] == 8)  # meta + 4 steps + ckpt + hb + ctx
+        check("step span stats", sp["by_name"].get("step", {}).get(
+            "count") == 4 and abs(sp["by_name"]["step"]["total_s"]
+                                  - 0.1) < 1e-6)
+        check("heartbeat event counted",
+              sp["events"].get("heartbeat") == 1)
+        check("context sampled", sp["context"]["samples"] == 1)
+        q = rep["queue"]
+        check("queue states joined", q is not None
+              and q["jobs"]["bench"]["state"] == "done"
+              and q["jobs"]["sweep"]["state"] == "failed")
+        check("queue wall computed",
+              q["jobs"]["bench"].get("wall_s") == 61.0)
+        check("salvage evidence carried",
+              q["jobs"]["sweep"]["salvaged_artifacts"] == 1)
+        check("torn journal tail dropped", q["dropped_lines"] == 1)
+        check("bench line joined", rep["bench"]
+              and rep["bench"][0]["value"] == 1207.7
+              and rep["bench"][0]["recompile_count"] == 7)
+        check("loss log v2 read", rep["loss"]
+              and rep["loss"][0]["schema"] == "loss-log-v2"
+              and rep["loss"][0]["grad_norm"]["final"] == 7.0)
+        check("report files written",
+              os.path.exists(os.path.join(tmp, "out", "report.json"))
+              and os.path.exists(os.path.join(tmp, "out", "report.md")))
+        md = open(os.path.join(tmp, "out", "report.md")).read()
+        check("markdown carries queue table", "| bench | done |" in md)
+
+    ok = not failures
+    print(json.dumps({"tool": "obs_report", "selfcheck": True, "ok": ok,
+                      "failures": failures}))
+    sys.stdout.flush()
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--round", default=None,
+                   help="artifacts round name (default $GRAFT_ROUND)")
+    p.add_argument("--span-log", action="append", default=[],
+                   help="span JSONL path; repeat (default "
+                        "artifacts/<round>/obs/*.jsonl)")
+    p.add_argument("--queue-dir", default=None,
+                   help="tpu_queue spool dir (default "
+                        "artifacts/<round>/queue when present)")
+    p.add_argument("--bench", action="append", default=[],
+                   help="bench JSON-line file; repeat (default "
+                        "artifacts/<round>/BENCH_*.json)")
+    p.add_argument("--loss-log", action="append", default=[],
+                   help="loss_log.json sidecar (v1 or v2); repeat")
+    p.add_argument("--out", default=None,
+                   help="output dir (default artifacts/<round>/obs)")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="seeded fixtures -> report invariants, then exit")
+    args = p.parse_args(argv)
+    if args.selfcheck:
+        return selfcheck()
+    rep = generate(args)
+    print(json.dumps(rep, sort_keys=True))
+    sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -208,9 +208,13 @@ def main() -> None:
             import shutil
             shutil.rmtree(save)
         os.makedirs(save, exist_ok=True)
-        t0 = time.time()
-        train(cfg)
-        wall = time.time() - t0
+        # flight-recorder span: the duration feeds the DONE marker, and
+        # when $OBS_SPAN_LOG is exported (tpu_queue does) the round report
+        # sees each row's training phase
+        from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
+        with maybe_tracer().span("train-row", save=save) as sp:
+            train(cfg)
+        wall = sp.dur_s
         # atomic: a truncated marker would read as "training complete"
         atomic_write_bytes(marker, ("wall_s=%.1f\n" % wall).encode())
         log("training %s done in %.0fs" % (save, wall))

@@ -164,9 +164,11 @@ def main() -> None:
                  topk=100, conf_th=0.3 if ckpt else 0.01, nms="nms",
                  nms_th=0.5, amp=True, model_load=ckpt or "",
                  save_path=export_dir, export_raw_input=True)
-    t0 = time.time()
-    export_predict(cfg, export_dir)
-    results["export_s"] = round(time.time() - t0, 1)
+    from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
+    tracer = maybe_tracer()
+    with tracer.span("export", dir=export_dir) as sp:
+        export_predict(cfg, export_dir)
+    results["export_s"] = round(sp.dur_s, 1)
     log("exported to %s in %.1fs" % (export_dir, results["export_s"]))
 
     img_path = os.path.join(WORK, "img.u8")
@@ -199,39 +201,43 @@ def main() -> None:
         cmd = [RUNNER, PLUGIN, export_dir, "--image", img_path,
                "--iters", str(iters), "--depth", str(depth)] + opts
         log("running depth=%d: %s" % (depth, " ".join(cmd[:6]) + " ..."))
-        t0 = time.time()
-        try:
-            # Popen + beating wait instead of a blind subprocess.run: the
-            # C++ runner legitimately takes minutes (remote compile), and
-            # a silent 1800 s wait would read as a hang to the supervisor
-            # — whose SIGTERM would orphan a TPU-claiming child (the
-            # wedge hazard this script exists to avoid).
-            proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                    stderr=subprocess.PIPE, text=True)
-            deadline = time.time() + 1800
-            while proc.poll() is None and time.time() < deadline:
-                HB.beat("runner depth=%d running" % depth)
-                time.sleep(10)
-            if proc.poll() is None:
-                proc.kill()
-                proc.communicate()
-                raise subprocess.TimeoutExpired(cmd, 1800)
-            r_stdout, r_stderr = proc.communicate()
-            r = subprocess.CompletedProcess(cmd, proc.returncode,
-                                            r_stdout, r_stderr)
-        except subprocess.TimeoutExpired:
-            # A timeout here killed a TPU-claiming process — the claim may
-            # now be wedged (CLAUDE.md). Launching the next depth would
-            # block on the wedged claim and get timeout-killed in turn,
-            # serially re-wedging the chip; abort the sweep instead.
-            results["runs"]["depth%d" % depth] = {"error": "timeout 1800s"}
-            results["aborted"] = ("depth%d timed out; remaining depths "
-                                  "skipped to avoid re-wedging the device "
-                                  "claim" % depth)
-            flush(results)
+        with tracer.span("runner", depth=depth) as run_span:
+            try:
+                # Popen + beating wait instead of a blind subprocess.run:
+                # the C++ runner legitimately takes minutes (remote
+                # compile), and a silent 1800 s wait would read as a hang
+                # to the supervisor — whose SIGTERM would orphan a
+                # TPU-claiming child (the wedge hazard this script exists
+                # to avoid).
+                proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                        stderr=subprocess.PIPE, text=True)
+                deadline = time.time() + 1800
+                while proc.poll() is None and time.time() < deadline:
+                    HB.beat("runner depth=%d running" % depth)
+                    time.sleep(10)
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.communicate()
+                    raise subprocess.TimeoutExpired(cmd, 1800)
+                r_stdout, r_stderr = proc.communicate()
+                r = subprocess.CompletedProcess(cmd, proc.returncode,
+                                                r_stdout, r_stderr)
+            except subprocess.TimeoutExpired:
+                # A timeout here killed a TPU-claiming process — the claim
+                # may now be wedged (CLAUDE.md). Launching the next depth
+                # would block on the wedged claim and get timeout-killed in
+                # turn, serially re-wedging the chip; abort the sweep.
+                results["runs"]["depth%d" % depth] = {
+                    "error": "timeout 1800s"}
+                results["aborted"] = ("depth%d timed out; remaining depths "
+                                      "skipped to avoid re-wedging the "
+                                      "device claim" % depth)
+                flush(results)
+                r = None
+        if r is None:
             break
         rec = parse_runner(r.stdout)
-        rec["wall_s"] = round(time.time() - t0, 1)
+        rec["wall_s"] = round(run_span.dur_s, 1)
         rec["rc"] = r.returncode
         if r.returncode != 0:
             rec["stderr_tail"] = r.stderr.strip().splitlines()[-3:]

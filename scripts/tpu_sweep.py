@@ -134,6 +134,13 @@ def main() -> None:
             break
     log("backend: %s (%s)" % (device_kind, platform))
 
+    # flight recorder: compile spans + host context into the round's span
+    # log when $OBS_SPAN_LOG is set (tpu_queue exports it for every job);
+    # disabled spans still TIME (the per-cell compile_s fields read them)
+    from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
+    tracer = maybe_tracer()
+    tracer.context(phase="tpu_sweep", platform=platform)
+
     from real_time_helmet_detection_tpu.config import Config
     from real_time_helmet_detection_tpu.data import synthetic_target_batch
     from real_time_helmet_detection_tpu.models import build_model
@@ -237,17 +244,16 @@ def main() -> None:
         predict = make_predict_fn(model, cfg)
         images = jnp.asarray(rng.standard_normal(
             (batch, imsize, imsize, 3)).astype(np.float32))
-        t0 = time.perf_counter()
-        compiled = predict_chain(predict, n).lower(
-            variables, images).compile()
-        compile_s = time.perf_counter() - t0
+        with tracer.span("compile", section="inference", batch=batch) as sp:
+            compiled = predict_chain(predict, n).lower(
+                variables, images).compile()
         fl = flops_of(compiled)
         images, s = compiled(variables, images)  # warmup (donates images)
         np.asarray(s)
         dt = chain_timed_fetch(compiled, variables, images, overhead)
         rec = {"batch": batch, "img_per_sec": round(batch * n / dt, 1),
                "ms_per_batch": round(dt / n * 1e3, 3),
-               "compile_s": round(compile_s, 1)}
+               "compile_s": round(sp.dur_s, 1)}
         if fl:
             rec["mfu_fwd"] = round(fl * n / dt / peak, 4)
         return rec
@@ -265,10 +271,11 @@ def main() -> None:
         arrs = tuple(jnp.asarray(a) for a in synthetic_target_batch(
             batch, sz, pos_rate=0.01))
         train_n = make_scanned_train_fn(body, n)
-        t0 = time.perf_counter()
-        compiled = jax.jit(train_n, donate_argnums=(0,)).lower(
-            state, *arrs).compile()
-        compile_s = time.perf_counter() - t0
+        with tracer.span("compile", section="train", batch=batch,
+                         remat=cfg.remat) as sp:
+            compiled = jax.jit(train_n, donate_argnums=(0,)).lower(
+                state, *arrs).compile()
+        compile_s = sp.dur_s
         fl = flops_of(compiled)
         mem = memory_analysis_of(compiled)
         np.asarray(compiled(state, *arrs)[1])  # warmup (donates state)
@@ -321,17 +328,17 @@ def main() -> None:
                 quant_scales=scales if dtype_name == "int8" else None)
             images = jnp.asarray(rng.standard_normal(
                 (batch, imsize, imsize, 3)).astype(np.float32))
-            t0 = time.perf_counter()
-            compiled = predict_chain(predict, n).lower(
-                variables, images).compile()
-            compile_s = time.perf_counter() - t0
+            with tracer.span("compile", section="int8", batch=batch,
+                             dtype=dtype_name) as sp:
+                compiled = predict_chain(predict, n).lower(
+                    variables, images).compile()
             images, s = compiled(variables, images)  # warmup (donates)
             np.asarray(s)
             dt = chain_timed_fetch(compiled, variables, images, overhead)
             rec[dtype_name] = {
                 "img_per_sec": round(batch * n / dt, 1),
                 "ms_per_batch": round(dt / n * 1e3, 3),
-                "compile_s": round(compile_s, 1)}
+                "compile_s": round(sp.dur_s, 1)}
             hb.beat("int8 section b=%d %s done" % (batch, dtype_name))
         rec["int8_vs_bf16"] = round(
             rec["int8"]["img_per_sec"] / rec["bf16"]["img_per_sec"], 3)

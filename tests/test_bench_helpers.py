@@ -215,6 +215,36 @@ def test_find_last_tpu_result_carries_int8_fields(tmp_path):
     assert got["latency_ms_b1"] == 1.4
 
 
+def test_find_last_tpu_result_carries_obs_fields(tmp_path):
+    """ISSUE 6 satellite: the JSON line's flight-recorder keys
+    (recompile_count, loadavg) survive find_last_tpu_result; span_log is a
+    diagnostic pointer and deliberately does NOT ride (it names a file on
+    the box that produced the line, meaningless to later consumers)."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r09", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1250.0,
+        "mfu_train": 0.53, "recompile_count": 7,
+        "loadavg": [1.1, 1.4, 1.9], "span_log": "/tmp/spans.jsonl"})
+    got = bench.find_last_tpu_result(root)
+    assert got["recompile_count"] == 7
+    assert got["loadavg"] == [1.1, 1.4, 1.9]
+    assert "span_log" not in got
+    # pre-existing consumer contract unchanged
+    assert got["value"] == 1250.0
+    assert got["mfu_train"] == 0.53
+
+
+def test_find_last_tpu_result_old_lines_lack_obs_keys(tmp_path):
+    """A pre-flight-recorder artifact resolves exactly as before."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r05", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1100.0})
+    got = bench.find_last_tpu_result(root)
+    assert got["value"] == 1100.0
+    assert "recompile_count" not in got
+    assert "loadavg" not in got
+
+
 def test_find_last_tpu_result_old_lines_unaffected_by_int8_keys(tmp_path):
     """A pre-int8 artifact (no infer_dtype key) must still resolve with
     the same fields as before — consumers never see a surprise key."""

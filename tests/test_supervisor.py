@@ -485,7 +485,8 @@ def test_jobs_run_fifo_and_serially(tmp_path):
     sp.close()
 
 
-def test_job_env_carries_heartbeat_and_status_paths(tmp_path):
+def test_job_env_carries_heartbeat_and_status_paths(tmp_path, monkeypatch):
+    monkeypatch.delenv("OBS_SPAN_LOG", raising=False)
     clock = FakeClock()
     sp = Spool(str(tmp_path / "q"))
     enqueue(sp, "j1", env={"EXTRA": "1"})
@@ -495,4 +496,20 @@ def test_job_env_carries_heartbeat_and_status_paths(tmp_path):
     assert env["TPU_QUEUE_HEARTBEAT"] == sp.heartbeat_path("j1")
     assert env["TPU_QUEUE_STATUS"] == sp.status_path("j1", 1)
     assert env["EXTRA"] == "1"
+    # flight recorder (ISSUE 6): every queued job writes spans into the
+    # round's obs/ log next to the queue dir, so obs_report.py can join
+    # the journal with what each job was actually doing
+    assert env["OBS_SPAN_LOG"] == os.path.join(
+        os.path.dirname(sp.root), "obs", "spans.jsonl")
+    sp.close()
+
+
+def test_job_env_respects_explicit_span_log(tmp_path):
+    clock = FakeClock()
+    sp = Spool(str(tmp_path / "q"))
+    enqueue(sp, "j1", env={"OBS_SPAN_LOG": "/custom/spans.jsonl"})
+    sup = make_sup(sp, clock, waiters=[FakeWaiter(clock)])
+    sup.run()
+    _, _, env = sup.spawned[0]
+    assert env["OBS_SPAN_LOG"] == "/custom/spans.jsonl"
     sp.close()
