@@ -79,10 +79,11 @@ DEFAULT_HBM = 819e9  # v5e
 # and runner_drive.py (they diverged in r5: mfu_breakdown defaulted to r05
 # while the rest stayed at r04, scattering same-round artifacts — ADVICE
 # r5 #3); bump it here when a new round starts, or override per-run with
-# $GRAFT_ROUND. r08 = the int8 inference-compression round (ISSUE 5);
-# earlier rounds' artifact dirs are committed history and must not be
+# $GRAFT_ROUND. r09 = the memory-traffic-strike round (ISSUE 7:
+# param-dtype policy + fused BN epilogue + roofline --diff); earlier
+# rounds' artifact dirs are committed history and must not be
 # overwritten.
-GRAFT_ROUND_DEFAULT = "r08"
+GRAFT_ROUND_DEFAULT = "r09"
 
 # v5e int8 MXU peak (2x the bf16 peak — jax-ml scaling-book): the
 # denominator for int8-path MFU and the hardware case for --infer-dtype
@@ -238,7 +239,8 @@ def find_last_tpu_result(repo_root: str | None = None) -> dict | None:
             "latency_ms_b1", "train_img_per_sec_chip", "train_step_ms",
             "mfu_train", "mfu_fwd", "device_kind", "peak_pallas_us",
             "peak_xla_us", "pallas_matches_xla", "infer_dtype", "int8_fps",
-            "int8_vs_bf16", "recompile_count", "loadavg")
+            "int8_vs_bf16", "recompile_count", "loadavg", "param_policy",
+            "epilogue")
     out.update({k: rec[k] for k in keep if k in rec})
     return out
 
@@ -558,13 +560,23 @@ def _bench(out: dict, hb) -> None:
         from real_time_helmet_detection_tpu.train import (
             create_train_state, make_scanned_train_fn, make_train_step_body)
         # step-compression knobs under A/B from the driver/chains:
-        # BENCH_REMAT={none,stacks,full}, BENCH_LOSS_KERNEL={auto,fused,xla}
+        # BENCH_REMAT={none,stacks,full}, BENCH_LOSS_KERNEL={auto,fused,xla},
+        # BENCH_PARAM_POLICY={fp32,bf16-compute}, BENCH_EPILOGUE=
+        # {auto,fused,xla} (ISSUE 7; bf16-compute needs the bf16 policy,
+        # so it is forced to fp32 under BENCH_DTYPE=fp32)
+        param_policy = os.environ.get("BENCH_PARAM_POLICY", "fp32")
+        if dtype is None and param_policy != "fp32":
+            log("BENCH_PARAM_POLICY=%s needs bf16 (--amp); forcing fp32"
+                % param_policy)
+            param_policy = "fp32"
         tcfg = Config(num_stack=1, hourglass_inch=128, num_cls=2,
                       batch_size=train_batch, amp=dtype is not None,
                       imsize=imsize,
                       remat=os.environ.get("BENCH_REMAT", "none"),
                       loss_kernel=os.environ.get("BENCH_LOSS_KERNEL",
-                                                 "auto"))
+                                                 "auto"),
+                      param_policy=param_policy,
+                      epilogue=os.environ.get("BENCH_EPILOGUE", "auto"))
         tmodel = build_model(tcfg, dtype=dtype)
         tx = build_optimizer(tcfg, 100)
         state = create_train_state(tmodel, tcfg, jax.random.key(0), imsize, tx)
@@ -607,12 +619,33 @@ def _bench(out: dict, hb) -> None:
             out["mfu_train"] = round(train_flops * n_train / dt / peak, 4)
         # why-MFU-moved context for the BENCH_rNN trajectory: the active
         # step-compression settings + the step's cost-analysis HBM bytes
+        from real_time_helmet_detection_tpu.models import resolve_epilogue
         from real_time_helmet_detection_tpu.train import resolve_loss_kernel
         out["hbm_bytes_per_step"] = train_bytes
         out["remat"] = tcfg.remat
         out["loss_kernel"] = resolve_loss_kernel(tcfg)
+        out["param_policy"] = tcfg.param_policy
+        out["epilogue"] = resolve_epilogue(tcfg)
         out["mfu_peak_flops"] = peak
         out["mfu_peak_known"] = peak_known
+        try:
+            # convert_bytes_pct: the roofline counting model's convert
+            # class share of the timed train program (operand+result per
+            # reportable op, scripts/roofline.py) — the ONE JSON line's
+            # own evidence of whether the param-policy/epilogue levers
+            # are doing their job on this exact program
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "scripts"))
+            import roofline as _roofline
+            _comps, _fb, _ap = _roofline.parse_hlo(tcompiled.as_text())
+            _rows = _roofline.attribute(_comps, _fb, _ap)
+            _tot = sum(r["bytes"] for r in _rows)
+            _cvt = sum(r["bytes"] for r in _rows
+                       if r["class"] == "convert")
+            out["convert_bytes_pct"] = (round(100.0 * _cvt / _tot, 2)
+                                        if _tot else None)
+        except Exception as e:  # noqa: BLE001 — never block the bench
+            log("convert-bytes attribution unavailable: %r" % e)
         log("train: %.1f img/s/chip (%.2f ms/step)"
             % (train_batch * n_train / dt, dt / n_train * 1e3))
     except Exception as e:  # noqa: BLE001

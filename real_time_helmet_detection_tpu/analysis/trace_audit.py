@@ -239,7 +239,7 @@ _TINY = dict(num_stack=1, hourglass_inch=16, num_cls=2, imsize=64)
 _BATCH = 2
 
 
-def _tiny_train_parts(remat: str = "none"):
+def _tiny_train_parts(remat: str = "none", param_policy: str = "fp32"):
     import jax
     import jax.numpy as jnp
 
@@ -250,8 +250,11 @@ def _tiny_train_parts(remat: str = "none"):
     from ..train import (create_train_state, make_scanned_train_fn,
                          make_train_step_body)
 
-    cfg = Config(batch_size=_BATCH, remat=remat, loss_kernel="xla", **_TINY)
-    model = build_model(cfg)
+    # bf16-compute requires the bf16 compute policy (config.py validates)
+    cfg = Config(batch_size=_BATCH, remat=remat, loss_kernel="xla",
+                 amp=param_policy == "bf16-compute",
+                 param_policy=param_policy, **_TINY)
+    model = build_model(cfg, dtype=jnp.bfloat16 if cfg.amp else None)
     tx = build_optimizer(cfg, 10)
     state = create_train_state(model, cfg, jax.random.key(0),
                                _TINY["imsize"], tx)
@@ -262,7 +265,8 @@ def _tiny_train_parts(remat: str = "none"):
     return train_n, (state,) + arrs
 
 
-def _tiny_predict_parts(normalize: Optional[str] = None):
+def _tiny_predict_parts(normalize: Optional[str] = None,
+                        epilogue: str = "auto"):
     import jax
     import numpy as np
 
@@ -271,7 +275,8 @@ def _tiny_predict_parts(normalize: Optional[str] = None):
     from ..predict import make_predict_fn
     from ..train import init_variables
 
-    cfg = Config(topk=16, conf_th=0.0, nms_th=0.5, **_TINY)
+    cfg = Config(topk=16, conf_th=0.0, nms_th=0.5, epilogue=epilogue,
+                 **_TINY)
     model = build_model(cfg)
     params, batch_stats = init_variables(model, jax.random.key(0),
                                          _TINY["imsize"])
@@ -337,12 +342,14 @@ def audit_repo_entry_points(lower: bool = True) -> List[Finding]:
 
     Entries mirror the production surfaces: the scanned train step
     (bench.py/scaling.py's timed program) across the tpu_sweep
-    step-grid remat policies, the jitted predict fn (eval), the donating
-    predict chain (bench), the quantized int8 predict + its donating
-    chain (--infer-dtype int8, ops/quant.py — the program tpu_sweep's
-    int8 section times), the raw-uint8-wire predict (eval driver /
-    export --export-raw-input), and the export fn (the C++ runner's
-    artifact)."""
+    step-grid remat policies AND under --param-policy bf16-compute (the
+    fp32-master state restructure, ISSUE 7), the jitted predict fn
+    (eval), its --epilogue fused twin (the custom_vjp BN+activation
+    epilogue), the donating predict chain (bench), the quantized int8
+    predict + its donating chain (--infer-dtype int8, ops/quant.py — the
+    program tpu_sweep's int8 section times), the raw-uint8-wire predict
+    (eval driver / export --export-raw-input), and the export fn (the
+    C++ runner's artifact)."""
     findings: List[Finding] = []
     grid_sigs: Dict[str, str] = {}
 
@@ -384,6 +391,24 @@ def audit_repo_entry_points(lower: bool = True) -> List[Finding]:
                         % ", ".join(sorted(entries))))
 
     try:
+        # the bf16-param-policy scanned step (--param-policy bf16-compute,
+        # ISSUE 7): the fp32-master optimizer restructures both the state
+        # pytree and the update tail, so its donation/f64/dynamic-shape
+        # surface is audited separately from the fp32 grid above
+        entry = "train_step_scanned[param=bf16-compute]"
+        train_n, targs = _tiny_train_parts("none", "bf16-compute")
+        findings += audit_entry(train_n, targs, entry,
+                                donate_argnums=(0,), lower=lower)
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            rule="trace/trace-failure",
+            path="<train_step_scanned[param=bf16-compute]>",
+            context="train_step_scanned[param=bf16-compute]",
+            message="entry construction failed: %s: %s"
+                    % (type(e).__name__,
+                       (str(e).splitlines() or ["?"])[0][:200])))
+
+    try:
         predict, variables, images = _tiny_predict_parts()
         findings += audit_entry(
             lambda v, im: predict(v, im), (variables, images), "predict",
@@ -395,6 +420,24 @@ def audit_repo_entry_points(lower: bool = True) -> List[Finding]:
     except Exception as e:  # noqa: BLE001
         findings.append(Finding(
             rule="trace/trace-failure", path="<predict>", context="predict",
+            message="entry construction failed: %s: %s"
+                    % (type(e).__name__,
+                       (str(e).splitlines() or ["?"])[0][:200])))
+
+    try:
+        # the fused-epilogue predict (--epilogue fused, ISSUE 7): the
+        # custom_vjp epilogue replaces every BN+activation tail — its
+        # trace must stay as clean as the plain predict (off-TPU this
+        # audits the jnp recompute twin, the same program roofline counts)
+        predict_e, variables_e, images_e = _tiny_predict_parts(
+            epilogue="fused")
+        findings += audit_entry(
+            lambda v, im: predict_e(v, im), (variables_e, images_e),
+            "predict_epilogue_fused", lower=lower)
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            rule="trace/trace-failure", path="<predict_epilogue_fused>",
+            context="predict_epilogue_fused",
             message="entry construction failed: %s: %s"
                     % (type(e).__name__,
                        (str(e).splitlines() or ["?"])[0][:200])))

@@ -79,6 +79,19 @@ class Config:
 
     # precision (TPU: bf16 policy replaces CUDA AMP + GradScaler)
     amp: bool = False
+    param_policy: str = "fp32"    # train-step parameter dtype policy
+    # (ISSUE 7): "fp32" = the pre-PR program, params fp32 in TrainState
+    # and recast to bf16 at every use site under --amp (the r07 roofline's
+    # convert_convert_fusion rows); "bf16-compute" = TrainState carries a
+    # once-cast bf16 compute copy, the fp32 MASTER lives inside the
+    # optimizer state (optim.with_fp32_master) and the bf16 re-emission
+    # fuses into the Adam update — the per-step param-convert traffic
+    # disappears. Requires --amp (without it the compute dtype would
+    # silently change) and --sub-divisions 1 (MultiSteps would accumulate
+    # micro-grads in bf16; the accumulation path keeps its fp32 master in
+    # params). Gradient-equality vs fp32 is pinned by
+    # tests/test_param_policy.py; checkpoints record the policy's dtypes,
+    # so resume with the same --param-policy.
 
     # distributed (multi-host over DCN; in-host over ICI mesh)
     world_size: int = 1           # number of hosts
@@ -186,6 +199,15 @@ class Config:
     # ops/pallas/loss.py), "auto" = fused on TPU, xla elsewhere (same
     # backend gating as the fused peak kernel). Off-TPU "fused" runs in
     # (slow) interpret mode — test/debug only.
+    epilogue: str = "auto"        # conv BN+activation tail implementation:
+    # "xla" (nn.BatchNorm + Activation, the pre-PR composition), "fused"
+    # (one-pass BN-normalize+activation with a recompute backward,
+    # ops/pallas/epilogue.py — Pallas on TPU, the jnp custom_vjp twin
+    # elsewhere), "auto" = fused on TPU, xla elsewhere (the --loss-kernel
+    # gating). Eligibility per conv: BN present and unfolded, activation
+    # in {Mish, ReLU, Linear}, per-replica BN (sync-BN keeps xla) —
+    # ineligible convs silently keep the xla tail. Checkpoints
+    # interchange across modes (identical param tree, tested).
     stem_s2d: bool = False        # compute the 7x7 s2 stem conv in its
     # space-to-depth formulation (same arithmetic, MXU-friendlier
     # contraction; checkpoint-compatible either way)
@@ -234,6 +256,14 @@ class Config:
     summary: bool = True          # print a layer table at train start on
     # the chief (≡ reference torchsummary on rank 0, ref train.py:50;
     # --no-summary disables). Shape inference only — no device compute.
+    preset: str = ""              # "" | "sweep-best": override the
+    # step-compression train flags (batch-size, remat, loss-kernel,
+    # param-policy, epilogue[, amp]) from the newest committed
+    # `step_grid_selected` record in artifacts/*/sweep.json — the chip's
+    # own measured pick promoted to defaults (ISSUE 7 satellite). The
+    # preset WINS over individually-passed step flags (it is the "use
+    # what the sweep chose" button); errors loudly when no committed
+    # artifact carries a selection.
 
     def __post_init__(self):
         # pre-r7 compatibility: --remat was a boolean (Config(remat=True)
@@ -246,6 +276,27 @@ class Config:
         if self.loss_kernel not in ("auto", "fused", "xla"):
             raise ValueError("--loss-kernel must be one of auto|fused|xla, "
                              "got %r" % (self.loss_kernel,))
+        if self.epilogue not in ("auto", "fused", "xla"):
+            raise ValueError("--epilogue must be one of auto|fused|xla, "
+                             "got %r" % (self.epilogue,))
+        if self.param_policy not in ("fp32", "bf16-compute"):
+            raise ValueError("--param-policy must be 'fp32' or "
+                             "'bf16-compute', got %r" % (self.param_policy,))
+        if self.param_policy == "bf16-compute":
+            if not self.amp:
+                raise ValueError(
+                    "--param-policy bf16-compute requires --amp: without "
+                    "the bf16 compute policy the once-cast params would "
+                    "silently change the compute dtype itself")
+            if self.sub_divisions > 1:
+                raise ValueError(
+                    "--param-policy bf16-compute is incompatible with "
+                    "--sub-divisions > 1: optax.MultiSteps would "
+                    "accumulate micro-gradients in bf16 — keep the fp32 "
+                    "policy for accumulation runs")
+        if self.preset not in ("", "sweep-best"):
+            raise ValueError("--preset must be '' or 'sweep-best', got %r"
+                             % (self.preset,))
         if self.infer_dtype not in ("bf16", "int8"):
             raise ValueError("--infer-dtype must be 'bf16' or 'int8', "
                              "got %r" % (self.infer_dtype,))
@@ -304,6 +355,65 @@ def parse_args(argv=None) -> Config:
     return Config(**{f.name: d[f.name] for f in dataclasses.fields(Config)})
 
 
+def sweep_best_overrides(repo_root: Optional[str] = None) -> dict:
+    """Step-compression flags from the newest committed sweep selection.
+
+    Scans artifacts/*/sweep.json for a `step_grid_selected` record (the
+    best-throughput cell of tpu_sweep's batch x remat x loss-kernel x
+    param-policy x epilogue grid) and maps it onto Config field overrides.
+    Highest round wins — the committed artifact IS the promotion record,
+    so `--preset sweep-best` always tracks the chip's latest verdict.
+    Raises FileNotFoundError when no artifact carries a selection (a
+    fresh clone, or no chip round yet)."""
+    import glob
+    import re
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    best = None
+    for path in glob.glob(os.path.join(root, "artifacts", "*",
+                                       "sweep.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f).get("step_grid_selected")
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not rec or "batch" not in rec:
+            continue
+        m = re.search(r"r(\d+)",
+                      os.path.basename(os.path.dirname(path)))
+        key = int(m.group(1)) if m else -1
+        if best is None or key > best[0]:
+            best = (key, path, rec)
+    if best is None:
+        raise FileNotFoundError(
+            "--preset sweep-best: no artifacts/*/sweep.json carries a "
+            "step_grid_selected record — run tpu_sweep's step_grid "
+            "section (through tpu_queue.py) first")
+    _, path, rec = best
+    over = {"batch_size": int(rec["batch"]),
+            "remat": rec.get("remat", "none"),
+            "loss_kernel": rec.get("loss_kernel", "auto")}
+    # pre-ISSUE-7 selections lack the new axes: leave those fields at
+    # their CLI/default values rather than inventing a policy
+    for key in ("param_policy", "epilogue"):
+        if key in rec:
+            over[key] = rec[key]
+    if over.get("param_policy") == "bf16-compute":
+        over["amp"] = True  # the policy's own validity requirement
+    over["_source"] = os.path.relpath(path, root)
+    return over
+
+
+def apply_preset(cfg: Config) -> Config:
+    """Resolve `--preset` into concrete Config fields (no-op when unset)."""
+    if not cfg.preset:
+        return cfg
+    over = sweep_best_overrides()
+    src = over.pop("_source")
+    print("--preset sweep-best: %s -> %s" % (src, over), flush=True)
+    return dataclasses.replace(cfg, **over)
+
+
 def seed_everything(seed: int) -> None:
     """Global seeding (ref config.py:143-147). JAX RNG is explicit
     (jax.random.key), threaded through the train/data code; host-side
@@ -344,6 +454,7 @@ def get_config(argv=None) -> Config:
     """Full CLI entry (ref config.py:139-169): parse, seed, snapshot dirs,
     eval-time architecture restore."""
     cfg = parse_args(argv)
+    cfg = apply_preset(cfg)
     seed_everything(cfg.random_seed)
 
     if cfg.platform:

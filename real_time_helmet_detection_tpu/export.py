@@ -70,6 +70,15 @@ def export_predict(cfg: Config, out_dir: Optional[str] = None,
     os.makedirs(out_dir, exist_ok=True)
     imsize = cfg.imsize or 512
 
+    # serialized artifacts always take the XLA epilogue: a Pallas
+    # custom-call inside exported StableHLO would pin the artifact to the
+    # exporting libtpu (the C++ runner dlopens arbitrary plugins), and
+    # the eval-mode epilogue is a pointwise nicety, not the conv-bound
+    # artifact's bottleneck. Checkpoints are epilogue-agnostic, so this
+    # changes nothing about the weights.
+    import dataclasses as _dc
+    cfg = _dc.replace(cfg, epilogue="xla")
+
     model, variables = load_eval_state(cfg)
     normalize = cfg.pretrained if cfg.export_raw_input else None
 

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
+from ..ops.pallas.epilogue import (FUSED_EPILOGUE_ACTIVATIONS, fused_bn_act,
+                                   fused_bn_act_train)
 from ..ops.quant import quantize_activations, quantize_weights
 
 Dtype = Any
@@ -37,6 +39,24 @@ Dtype = Any
 # each quantized conv's input abs-max/percentile into the `quant`
 # collection; "int8" = int8 conv bodies consuming the calibrated scales.
 QUANT_MODES = ("off", "calibrate", "int8")
+
+# conv epilogue implementations (--epilogue; ISSUE 7): "xla" = the
+# nn.BatchNorm + Activation composition (the pre-PR program, bit-exact),
+# "fused" = the one-pass BN-normalize+activation epilogue
+# (ops/pallas/epilogue.py) where eligible.
+EPILOGUE_MODES = ("xla", "fused")
+
+
+def resolve_epilogue(cfg) -> str:
+    """'fused' | 'xla' for this backend: --epilogue auto selects the
+    fused BN+activation epilogue on TPU only, exactly as --loss-kernel
+    gates the fused loss (off-TPU 'fused' runs the jnp recompute twin —
+    test/attribution contexts select it explicitly)."""
+    mode = getattr(cfg, "epilogue", "auto")
+    if mode == "auto":
+        import jax
+        return "fused" if jax.default_backend() == "tpu" else "xla"
+    return mode
 
 
 def mish(x: jax.Array) -> jax.Array:
@@ -237,6 +257,66 @@ class QuantConv(nn.Module):
         return y + bias.astype(dt)
 
 
+class FusedBNAct(nn.Module):
+    """BatchNorm + activation with the normalize+activation chain collapsed
+    into ONE pointwise pass (ops/pallas/epilogue.py; `--epilogue fused`).
+
+    Param and batch_stats trees are IDENTICAL to
+    `nn.BatchNorm(momentum=0.9, epsilon=1e-5)` and the block instantiates
+    it under the same "BatchNorm_0" name, so checkpoints interchange
+    across every --epilogue mode and `ops.quant.fold_batchnorm` folds
+    this block exactly as it folds nn.BatchNorm (regression-tested).
+
+    The statistics stay in XLA (they are reductions, computed in f32 with
+    flax's formulas: mean, E[x^2]-E[x]^2 clamped at 0, and the same
+    momentum running update); only the pointwise tail leaves it:
+    `eff_scale = gamma * rsqrt(var + eps)`, `eff_bias = beta - mean *
+    eff_scale` — the PR 5 BN-fold algebra (ops/quant.py) applied at
+    train time to the batch statistics and at eval time to the running
+    statistics — feed `fused_bn_act`, whose custom_vjp recomputes the
+    backward instead of saving post-BN residuals."""
+    activation: str = "Mish"
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        feat = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((feat,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((feat,), jnp.float32))
+        scale = self.param("scale", nn.initializers.ones_init(), (feat,),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(), (feat,),
+                          jnp.float32)
+        if train:
+            # moments + normalize + activation + the ANALYTIC BN backward
+            # all live inside ONE custom_vjp (ops/pallas/epilogue.py) —
+            # XLA never autodiffs through the statistics, so no f32
+            # activation copies or backward-through-stats chains exist in
+            # the program. The returned batch moments feed ONLY the
+            # running buffers, stop_gradient'd exactly as flax BatchNorm
+            # treats them (the custom_vjp drops their zero cotangents).
+            out, mean, var = fused_bn_act_train(
+                x, scale, bias, eps=self.epsilon,
+                activation=self.activation)
+            if not self.is_initializing():
+                m = self.momentum
+                mean = jax.lax.stop_gradient(mean)
+                var = jax.lax.stop_gradient(var)
+                ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
+                ra_var.value = m * ra_var.value + (1.0 - m) * var
+            return out
+        # eval: running statistics fold into the per-channel affine (the
+        # PR 5 fold algebra) feeding the one-pass pointwise epilogue
+        eff_scale = scale * jax.lax.rsqrt(ra_var.value + self.epsilon)
+        eff_bias = bias - ra_mean.value * eff_scale
+        return fused_bn_act(x, eff_scale, eff_bias,
+                            activation=self.activation)
+
+
 class Convolution(nn.Module):
     """Conv -> optional BN -> activation (ref hourglass.py:94-108), with the
     reference's symmetric (k-1)//2 padding.
@@ -247,7 +327,16 @@ class Convolution(nn.Module):
     on the folded convs (`self.bn` and `quantize`; the stem and every
     bn-less conv — head, inter-stack merges — stay in the float dtype:
     the first/last-layer rule, and their contractions are not where the
-    roofline says the time is)."""
+    roofline says the time is).
+
+    `epilogue="fused"` (ISSUE 7) swaps the nn.BatchNorm + Activation tail
+    for the one-pass `FusedBNAct` where ELIGIBLE: the conv has a BN that
+    is not being folded away, the activation has a recomputable closed
+    form (Mish/ReLU/Linear — FUSED_EPILOGUE_ACTIVATIONS), and BN is
+    per-replica (cross-replica sync-BN keeps the XLA path: its stats
+    collective belongs to XLA). Ineligible combinations silently keep the
+    xla path — the decision table lives in docs/ARCHITECTURE.md "Step
+    compression"."""
     out_ch: int
     kernel_size: int = 3
     stride: int = 1
@@ -261,6 +350,7 @@ class Convolution(nn.Module):
     quant_mode: str = "off"  # off | calibrate | int8 (see QUANT_MODES)
     calib_percentile: float = 100.0
     quantize: bool = True   # eligibility: PreLayer's stem opts out
+    epilogue: str = "xla"   # xla | fused (see EPILOGUE_MODES)
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -289,6 +379,14 @@ class Convolution(nn.Module):
                         use_bias=self.use_bias or fold,
                         dtype=self.dtype)(x)
         if self.bn and not self.fold_bn:
+            if (self.epilogue == "fused" and self.bn_axis_name is None
+                    and self.activation in FUSED_EPILOGUE_ACTIVATIONS):
+                # same "BatchNorm_0" name as the nn.BatchNorm auto-name:
+                # the param tree (and every checkpoint) is identical
+                # whichever epilogue computes it
+                return FusedBNAct(activation=self.activation,
+                                  dtype=self.dtype,
+                                  name="BatchNorm_0")(x, train)
             x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                              epsilon=1e-5, dtype=self.dtype,
                              axis_name=self.bn_axis_name)(x)
@@ -307,12 +405,14 @@ class Residual(nn.Module):
     fold_bn: bool = False
     quant_mode: str = "off"
     calib_percentile: float = 100.0
+    epilogue: str = "xla"
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
                   fold_bn=self.fold_bn, quant_mode=self.quant_mode,
-                  calib_percentile=self.calib_percentile)
+                  calib_percentile=self.calib_percentile,
+                  epilogue=self.epilogue)
         y = Convolution(self.out_ch, self.kernel_size, self.stride,
                         use_bias=False, bn=True, activation=self.activation,
                         **kw)(x, train)
@@ -343,13 +443,15 @@ class Hourglass(nn.Module):
     fold_bn: bool = False
     quant_mode: str = "off"
     calib_percentile: float = 100.0
+    epilogue: str = "xla"
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         kw = dict(activation=self.activation, dtype=self.dtype,
                   bn_axis_name=self.bn_axis_name, fold_bn=self.fold_bn,
                   quant_mode=self.quant_mode,
-                  calib_percentile=self.calib_percentile)
+                  calib_percentile=self.calib_percentile,
+                  epilogue=self.epilogue)
         mid_ch = self.in_ch + self.increase_ch
 
         up1 = Residual(self.in_ch, **kw)(x, train)
@@ -359,8 +461,8 @@ class Hourglass(nn.Module):
             low = Hourglass(self.num_layer - 1, mid_ch, self.increase_ch,
                             self.activation, self.pool, self.dtype,
                             self.bn_axis_name, self.fold_bn,
-                            self.quant_mode, self.calib_percentile)(low,
-                                                                    train)
+                            self.quant_mode, self.calib_percentile,
+                            self.epilogue)(low, train)
         else:
             low = Residual(mid_ch, **kw)(low, train)
         low = Residual(self.in_ch, **kw)(low, train)
@@ -387,12 +489,14 @@ class PreLayer(nn.Module):
     fold_bn: bool = False
     quant_mode: str = "off"
     calib_percentile: float = 100.0
+    epilogue: str = "xla"
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
                   fold_bn=self.fold_bn, quant_mode=self.quant_mode,
-                  calib_percentile=self.calib_percentile)
+                  calib_percentile=self.calib_percentile,
+                  epilogue=self.epilogue)
         # the stem conv contracts over only 3 input channels and is the
         # first layer: it stays in the float dtype (quantize=False) —
         # folding its BN still applies
@@ -418,12 +522,14 @@ class Neck(nn.Module):
     fold_bn: bool = False
     quant_mode: str = "off"
     calib_percentile: float = 100.0
+    epilogue: str = "xla"
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
                   fold_bn=self.fold_bn, quant_mode=self.quant_mode,
-                  calib_percentile=self.calib_percentile)
+                  calib_percentile=self.calib_percentile,
+                  epilogue=self.epilogue)
         x = Pool(self.ch, self.pool, dtype=self.dtype)(x)
         x = Convolution(self.ch, 1, bn=True, activation=self.activation,
                         **kw)(x, train)
@@ -472,12 +578,16 @@ class StackedHourglass(nn.Module):
     # (consumes ops/quant.fold_batchnorm params; training stays BN'd)
     quant_mode: str = "off"  # off | calibrate | int8 (see QUANT_MODES)
     calib_percentile: float = 100.0
+    epilogue: str = "xla"   # conv BN+activation tail: "xla" (the pre-PR
+    # nn.BatchNorm + Activation composition) | "fused" (one-pass
+    # ops/pallas/epilogue.py kernel where eligible; see Convolution)
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
                   fold_bn=self.fold_bn, quant_mode=self.quant_mode,
-                  calib_percentile=self.calib_percentile)
+                  calib_percentile=self.calib_percentile,
+                  epilogue=self.epilogue)
         if self.dtype is not None:
             x = x.astype(self.dtype)
         x = PreLayer(mid_ch=128, out_ch=self.in_ch, activation=self.activation,
@@ -546,4 +656,5 @@ def build_model(args_or_cfg, dtype: Optional[Dtype] = None,
         fold_bn=fold_bn,
         quant_mode=quant_mode,
         calib_percentile=calib_percentile,
+        epilogue=resolve_epilogue(c),
     )

@@ -1,0 +1,516 @@
+"""Fused BatchNorm-normalize + activation epilogue (Pallas TPU kernel).
+
+Every conv in this architecture is followed by `BatchNorm -> activation`
+(models/hourglass.py `Convolution`, ref /root/reference/hourglass.py:94-108
+`Convolution`: conv -> BN -> act). The r07 roofline byte table showed that
+chain — NOT the loss — is where the recoverable non-conv HBM traffic
+lives: the XLA lowering materializes f32<->bf16 converts around the
+normalize (the `convert_convert`/`convert_select` fusion rows, ~30% of
+step bytes under `--amp`), and autodiff saves post-BN intermediates
+(tanh/softplus/sigmoid values for Mish, compare masks for ReLU) in the
+forward to re-read in the backward.
+
+Here the whole post-reduction chain collapses into ONE pointwise pass per
+direction over the conv output:
+
+* the batch statistics (train) / running statistics (eval) stay in XLA —
+  they are reductions, not pointwise work — and are folded into
+  per-channel `eff_scale = gamma * rsqrt(var + eps)` and `eff_bias =
+  beta - mean * eff_scale` (exactly the PR 5 BN-fold algebra of
+  ops/quant.fold_batchnorm, reused at train time);
+* the forward kernel computes `act(x * eff_scale + eff_bias)` reading x
+  once and writing the activation once — all f32 math lives in
+  VMEM/registers, no materialized converts, no saved residuals;
+* a `jax.custom_vjp` backward RECOMPUTES the forward terms from the same
+  inputs (the ops/pallas/loss.py pattern) and emits d(x) in one pass plus
+  per-channel partial sums for d(eff_scale)/d(eff_bias) — tiny (C,)
+  vectors whose epilogue XLA folds into the BN-parameter gradients;
+* layout: `(N, H, W, C) -> (N, H*W, C)` is a FREE bitcast (adjacent
+  row-major dims); rows block over the sublane axis, channels sit on the
+  128-wide lane axis — C=128 (the flagship width) fills v5e tiles
+  exactly.
+
+Off-TPU, `interpret=None` (the production default) selects a pure-jnp
+custom_vjp twin built from the SAME math helpers instead of Pallas
+interpret mode: identical semantics and identical recompute structure, so
+CPU tests run fast and scripts/roofline.py's operand+result counting model
+sees the real traffic shape of the fused path (the interpret lowering's
+dynamic-slice machinery would be counted as garbage — the same honesty
+problem loss_subprogram_cost solves analytically). Pass interpret=True to
+force the Pallas kernel in interpret mode (the parity tests do).
+
+Selection is `--epilogue {auto,fused,xla}` (config.py), auto = fused on
+TPU only, mirroring `--loss-kernel`; eligibility rules live in
+models/hourglass.py `Convolution` (docs/ARCHITECTURE.md "Step
+compression"). Parity vs the XLA composition is pinned in fp32 and bf16
+by tests/test_epilogue.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Activations the fused epilogue supports. Everything on this list has a
+# cheap closed-form derivative recomputable from the pre-activation value
+# alone; the exotic activations (PReLU carries a param, CELU/Sigmoid are
+# not used after BN in this architecture) stay on the XLA path.
+FUSED_EPILOGUE_ACTIVATIONS = ("Mish", "ReLU", "Linear")
+
+_ROW_BLOCK_CAP = 1024  # sublane-axis block rows (f32: 512 KB at C=128)
+
+# Trace-time call-site registry (scripts/roofline.py's analytic counting
+# of the fused path off-TPU): every fused_bn_act/fused_bn_act_train call
+# appends (kind, elems, itemsize) while tracing. Appending is a pure
+# host-side side effect — the traced program (and so the graftlint
+# retrace signature) is unaffected.
+_TRACE_SITES: list = []
+
+
+def reset_site_registry() -> None:
+    _TRACE_SITES.clear()
+
+
+def traced_sites() -> list:
+    """[(kind 'train'|'eval', n_elements, itemsize_bytes), ...] of every
+    epilogue call traced since the last reset."""
+    return list(_TRACE_SITES)
+
+
+def site_kernel_bytes(kind: str, elems: int, itemsize: int) -> float:
+    """Operand+result HBM bytes of the REAL kernel sequence for one
+    epilogue site (the same counting rule scripts/roofline.py applies to
+    every other op; C-sized vectors/partials are negligible and ignored).
+
+    train: stats pass reads x; fwd pass reads x, writes out; backward
+    sums pass reads (x, g); backward dx pass reads (x, g), writes dx
+    -> 8 activation-sized transfers. eval: the fwd pointwise pass only
+    -> 2 transfers."""
+    p = float(elems) * itemsize
+    return (8.0 if kind == "train" else 2.0) * p
+
+
+def _act_fwd(z: jax.Array, act: str) -> jax.Array:
+    """act(z) in f32 (ref hourglass.py:6-43 Mish/ReLU/Linear)."""
+    if act == "Mish":
+        return z * jnp.tanh(jax.nn.softplus(z))
+    if act == "ReLU":
+        return jnp.maximum(z, 0.0)
+    if act == "Linear":
+        return z
+    raise NotImplementedError("fused epilogue: unsupported activation %r"
+                              % act)
+
+
+def _act_grad(z: jax.Array, act: str) -> jax.Array:
+    """d act(z)/dz, recomputed from z (no saved residuals)."""
+    if act == "Mish":
+        t = jnp.tanh(jax.nn.softplus(z))
+        return t + z * (1.0 - t * t) * jax.nn.sigmoid(z)
+    if act == "ReLU":
+        # ties-at-zero: subgradient 0, matching jnp.maximum's JVP at the
+        # measure-zero z == 0 (max picks the second arg's tangent there)
+        return (z > 0.0).astype(z.dtype)
+    if act == "Linear":
+        return jnp.ones_like(z)
+    raise NotImplementedError("fused epilogue: unsupported activation %r"
+                              % act)
+
+
+def _row_block(rows: int) -> int:
+    """Largest divisor of `rows` <= the cap, preferring sublane multiples
+    (16 covers the bf16 tile; f32 needs only 8)."""
+    cap = min(rows, _ROW_BLOCK_CAP)
+    best = 1
+    for r in range(cap, 0, -1):
+        if rows % r == 0:
+            if r % 16 == 0:
+                return r
+            if best == 1:
+                best = r  # largest divisor at all, if no 16-multiple
+    return best
+
+
+def _fwd_kernel(x_ref, a_ref, b_ref, o_ref, *, act: str):
+    x = x_ref[0].astype(jnp.float32)          # (R, C)
+    z = x * a_ref[0] + b_ref[0]               # (C,) broadcasts over rows
+    o_ref[0] = _act_fwd(z, act).astype(o_ref.dtype)
+
+
+def _bwd_kernel(x_ref, a_ref, b_ref, g_ref, dx_ref, da_ref, db_ref, *,
+                act: str):
+    """Recompute z, emit dx in one pass + per-(sample, row-block) channel
+    partials for d(eff_scale)/d(eff_bias)."""
+    x = x_ref[0].astype(jnp.float32)
+    a = a_ref[0]
+    z = x * a + b_ref[0]
+    dz = g_ref[0].astype(jnp.float32) * _act_grad(z, act)
+    dx_ref[0] = (dz * a).astype(dx_ref.dtype)
+    da_ref[0, 0] = jnp.sum(dz * x, axis=0)    # (C,)
+    db_ref[0, 0] = jnp.sum(dz, axis=0)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fused(act: str, use_pallas: bool, interpret: bool):
+    """custom_vjp'd (x3 (N, R*, C), a (1, C) f32, b (1, C) f32) -> act(x*a+b).
+
+    Static knobs baked per cache entry (the ops/pallas/loss.py pattern) so
+    the custom_vjp function takes arrays only, and so the SAME function
+    object is reused across traces (retrace-stable, graftlint layer 1)."""
+
+    def jnp_fwd(x3, a2, b2):
+        z = x3.astype(jnp.float32) * a2 + b2
+        return _act_fwd(z, act).astype(x3.dtype)
+
+    def jnp_bwd(x3, a2, b2, g):
+        xf = x3.astype(jnp.float32)
+        z = xf * a2 + b2
+        dz = g.astype(jnp.float32) * _act_grad(z, act)
+        dx = (dz * a2).astype(x3.dtype)
+        da = jnp.sum(dz * xf, axis=(0, 1)).reshape(1, -1)
+        db = jnp.sum(dz, axis=(0, 1)).reshape(1, -1)
+        return dx, da, db
+
+    def pallas_fwd(x3, a2, b2):
+        n, rows, c = x3.shape
+        grid, x_spec, vec, _ = _specs(n, rows, c)
+        return pl.pallas_call(
+            functools.partial(_fwd_kernel, act=act),
+            grid=grid,
+            in_specs=[x_spec, vec, vec],
+            out_specs=x_spec,
+            out_shape=jax.ShapeDtypeStruct(x3.shape, x3.dtype),
+            interpret=interpret,
+        )(x3, a2, b2)
+
+    def pallas_bwd(x3, a2, b2, g):
+        n, rows, c = x3.shape
+        grid, x_spec, vec, part = _specs(n, rows, c)
+        nb = grid[1]
+        partial_shape = jax.ShapeDtypeStruct((n, nb, c), jnp.float32)
+        dx, da_p, db_p = pl.pallas_call(
+            functools.partial(_bwd_kernel, act=act),
+            grid=grid,
+            in_specs=[x_spec, vec, vec, x_spec],
+            out_specs=(x_spec, part, part),
+            out_shape=(jax.ShapeDtypeStruct(x3.shape, x3.dtype),
+                       partial_shape, partial_shape),
+            interpret=interpret,
+        )(x3, a2, b2, g)
+        # the per-block channel partials are tiny ((N, nb, C) f32); their
+        # reduction is the epilogue's only XLA work in backward
+        return dx, jnp.sum(da_p, axis=(0, 1)).reshape(1, -1), \
+            jnp.sum(db_p, axis=(0, 1)).reshape(1, -1)
+
+    fwd_impl = pallas_fwd if use_pallas else jnp_fwd
+    bwd_impl = pallas_bwd if use_pallas else jnp_bwd
+
+    @jax.custom_vjp
+    def fused(x3, a2, b2):
+        return fwd_impl(x3, a2, b2)
+
+    def fused_fwd(x3, a2, b2):
+        # residuals are the ALREADY-materialized inputs — nothing extra
+        # crosses HBM for autodiff
+        return fwd_impl(x3, a2, b2), (x3, a2, b2)
+
+    def fused_bwd(res, g):
+        return bwd_impl(*res, g)
+
+    fused.defvjp(fused_fwd, fused_bwd)
+    return fused
+
+
+def _resolve_pallas(interpret: bool | None):
+    if interpret is not None:
+        return True, bool(interpret)
+    return jax.default_backend() == "tpu", False
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fused_train(act: str, eps: float, use_pallas: bool,
+                      interpret: bool):
+    """custom_vjp'd train-mode BN+act over (x3 (N, R, C), gamma (1, C) f32,
+    beta (1, C) f32) -> (out, mean (C,), var (C,)).
+
+    Forward: batch moments in f32 (two-pass variance — E[(x-mean)^2]
+    fuses into the reduction read, no materialized f32 copy or x^2), then
+    the one-pass `act(x*a + b)` with the fold algebra's a/b.
+
+    Backward: the ANALYTIC BatchNorm+activation gradient, not XLA
+    autodiff — the whole backward-through-statistics chain collapses to
+    two per-channel sums S1 = sum(dz), S2 = sum(dz*x) plus ONE pointwise
+    pass `dx = a*dz - k2*x - k1` with per-channel constants:
+
+        z  = a*(x - mean) + beta,  a = gamma*rsqrt(var + eps)
+        dz = g * act'(z)
+        dgamma = rsqrt(var+eps) * (S2 - mean*S1),  dbeta = S1
+        k2 = a*(S2 - mean*S1) / ((var+eps)*N),  k1 = a*S1/N - k2*mean
+        dx = a*dz - k2*x - k1
+
+    The (mean, var) outputs exist ONLY to feed the running-statistics
+    buffers (the module stop_gradients them), so their cotangents are
+    structurally zero and the backward drops them — exactly flax
+    BatchNorm's semantics (running stats never carry gradient)."""
+
+    def _colsum(m2):
+        """Per-channel sum of a (rows, C) array, f32-accumulated, reading
+        the operand directly (no materialized f32 copy)."""
+        return jnp.sum(m2, axis=0, dtype=jnp.float32)
+
+    def _inner_cols(m2, n2):
+        """Per-channel inner product sum_r m[r,c]*n[r,c] as the DIAGONAL
+        of a Gram dot. XLA:CPU materializes elementwise reduction
+        operands (a full-size m*n buffer feeding the reduce — measured as
+        the bitcast_multiply/subtract_multiply rows of the r09
+        single-block study); a dot reads both operands straight from
+        their buffers and writes only (C, C). The off-diagonal compute is
+        wasted FLOPs (C x the useful work) on an otherwise idle unit —
+        this is the CPU TWIN only; the Pallas kernels accumulate these
+        sums in-register with zero extra traffic or FLOPs."""
+        gram = jax.lax.dot_general(m2, n2, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        return jnp.diagonal(gram)
+
+    def moments(xf2, count):
+        mean = _colsum(xf2) / count
+        var = jnp.maximum(_inner_cols(xf2, xf2) / count
+                          - jnp.square(mean), 0.0)
+        return mean, var
+
+    def coeffs(gamma2, beta2, mean, var):
+        a = gamma2 * jax.lax.rsqrt(var + eps)  # (1, C) f32
+        return a, beta2 - mean * a
+
+    # The twin computes in f32 END TO END (one shared f32 view of x per
+    # direction — the same single cast copy the XLA baseline's stats
+    # path materializes): injecting bf16 points mid-chain (a bf16 dz, a
+    # bf16 dot operand) makes XLA:CPU materialize a convert PAIR around
+    # each one, which is exactly the traffic being removed (measured: it
+    # doubled the flagship convert class). On TPU none of this exists —
+    # the kernels read bf16 and keep f32 in registers.
+    def jnp_fwd(x3, gamma2, beta2):
+        n, rows, c = x3.shape
+        xf = x3.astype(jnp.float32)
+        mean, var = moments(xf.reshape(n * rows, c), n * rows)
+        a, b = coeffs(gamma2, beta2, mean, var)
+        return _act_fwd(xf * a + b, act).astype(x3.dtype), mean, var
+
+    def jnp_bwd_math(x3, gamma2, beta2, mean, var, g):
+        n, rows, c = x3.shape
+        count = n * rows
+        r2 = 1.0 / (var + eps)                     # (C,) f32
+        a = gamma2 * jnp.sqrt(r2)                  # (1, C)
+        b = beta2 - mean * a
+        xf = x3.astype(jnp.float32)
+        # dz materializes ONCE (consumers: the two channel sums and the
+        # dx pass); everything else recomputes from xf
+        dz = g.astype(jnp.float32) * _act_grad(xf * a + b, act)
+        dz2 = dz.reshape(count, c)
+        xf2 = xf.reshape(count, c)
+        s1 = _colsum(dz2)                          # (C,)
+        s2 = _inner_cols(dz2, xf2)
+        ctr = s2 - mean * s1
+        dgamma = (jnp.sqrt(r2) * ctr).reshape(1, -1)
+        dbeta = s1.reshape(1, -1)
+        k2 = a * ctr * r2 / count
+        k1 = a * s1 / count - k2 * mean
+        dx = (a * dz - k2 * xf - k1).astype(x3.dtype)
+        return dx, dgamma, dbeta
+
+    def pallas_fwd(x3, gamma2, beta2):
+        n, rows, c = x3.shape
+        grid, x_spec, vec, part = _specs(n, rows, c)
+        nb = grid[1]
+        pshape = jax.ShapeDtypeStruct((n, nb, c), jnp.float32)
+        s, ss = pl.pallas_call(
+            _stats_kernel,
+            grid=grid,
+            in_specs=[x_spec],
+            out_specs=(part, part),
+            out_shape=(pshape, pshape),
+            interpret=interpret,
+        )(x3)
+        count = float(n * rows)
+        mean = jnp.sum(s, axis=(0, 1)) / count
+        var = jnp.maximum(jnp.sum(ss, axis=(0, 1)) / count
+                          - jnp.square(mean), 0.0)
+        a, b = coeffs(gamma2, beta2, mean, var)
+        out = pl.pallas_call(
+            functools.partial(_fwd_kernel, act=act),
+            grid=grid,
+            in_specs=[x_spec, vec, vec],
+            out_specs=x_spec,
+            out_shape=jax.ShapeDtypeStruct(x3.shape, x3.dtype),
+            interpret=interpret,
+        )(x3, a, b)
+        return out, mean, var
+
+    def pallas_bwd(x3, gamma2, beta2, mean, var, g):
+        n, rows, c = x3.shape
+        grid, x_spec, vec, part = _specs(n, rows, c)
+        nb = grid[1]
+        count = float(n * rows)
+        r2 = 1.0 / (var + eps)
+        a = gamma2 * jnp.sqrt(r2)
+        b = beta2 - mean * a
+        pshape = jax.ShapeDtypeStruct((n, nb, c), jnp.float32)
+        # pass 1: recompute dz from (x, g), emit S1/S2 partials only —
+        # dz itself never touches HBM
+        s1_p, s2_p = pl.pallas_call(
+            functools.partial(_bwd_sums_kernel, act=act),
+            grid=grid,
+            in_specs=[x_spec, vec, vec, x_spec],
+            out_specs=(part, part),
+            out_shape=(pshape, pshape),
+            interpret=interpret,
+        )(x3, a, b, g)
+        s1 = jnp.sum(s1_p, axis=(0, 1))
+        s2 = jnp.sum(s2_p, axis=(0, 1))
+        ctr = s2 - mean * s1
+        dgamma = (jnp.sqrt(r2) * ctr).reshape(1, -1)
+        dbeta = s1.reshape(1, -1)
+        k2 = (a * ctr * r2 / count).astype(jnp.float32)
+        k1 = a * s1.reshape(1, -1) / count - k2 * mean
+        # pass 2: recompute dz again, write dx in one pass
+        dx = pl.pallas_call(
+            functools.partial(_bwd_dx_kernel, act=act),
+            grid=grid,
+            in_specs=[x_spec, vec, vec, x_spec, vec, vec],
+            out_specs=x_spec,
+            out_shape=jax.ShapeDtypeStruct(x3.shape, x3.dtype),
+            interpret=interpret,
+        )(x3, a, b, g, k1, k2)
+        return dx, dgamma, dbeta
+
+    fwd_impl = pallas_fwd if use_pallas else jnp_fwd
+
+    @jax.custom_vjp
+    def fused(x3, gamma2, beta2):
+        return fwd_impl(x3, gamma2, beta2)
+
+    def fused_fwd(x3, gamma2, beta2):
+        out, mean, var = fwd_impl(x3, gamma2, beta2)
+        return (out, mean, var), (x3, gamma2, beta2, mean, var)
+
+    def fused_bwd(res, cots):
+        x3, gamma2, beta2, mean, var = res
+        g, _g_mean, _g_var = cots  # statistics outputs: buffers only,
+        # stop_gradient'd by the module — their cotangents are zero
+        if use_pallas:
+            return pallas_bwd(x3, gamma2, beta2, mean, var, g)
+        return jnp_bwd_math(x3, gamma2, beta2, mean, var, g)
+
+    fused.defvjp(fused_fwd, fused_bwd)
+    return fused
+
+
+def _specs(n, rows, c):
+    r = _row_block(rows)
+    grid = (n, rows // r)
+    x_spec = pl.BlockSpec((1, r, c), lambda i, j: (i, j, 0),
+                          memory_space=pltpu.VMEM)
+    vec = pl.BlockSpec((1, c), lambda i, j: (0, 0),
+                       memory_space=pltpu.VMEM)
+    part = pl.BlockSpec((1, 1, c), lambda i, j: (i, j, 0),
+                        memory_space=pltpu.VMEM)
+    return grid, x_spec, vec, part
+
+
+def _stats_kernel(x_ref, s_ref, ss_ref):
+    x = x_ref[0].astype(jnp.float32)
+    s_ref[0, 0] = jnp.sum(x, axis=0)
+    ss_ref[0, 0] = jnp.sum(x * x, axis=0)
+
+
+def _bwd_sums_kernel(x_ref, a_ref, b_ref, g_ref, s1_ref, s2_ref, *,
+                     act: str):
+    x = x_ref[0].astype(jnp.float32)
+    z = x * a_ref[0] + b_ref[0]
+    dz = g_ref[0].astype(jnp.float32) * _act_grad(z, act)
+    s1_ref[0, 0] = jnp.sum(dz, axis=0)
+    s2_ref[0, 0] = jnp.sum(dz * x, axis=0)
+
+
+def _bwd_dx_kernel(x_ref, a_ref, b_ref, g_ref, k1_ref, k2_ref, dx_ref, *,
+                   act: str):
+    x = x_ref[0].astype(jnp.float32)
+    a = a_ref[0]
+    z = x * a + b_ref[0]
+    dz = g_ref[0].astype(jnp.float32) * _act_grad(z, act)
+    dx_ref[0] = (a * dz - k2_ref[0] * x - k1_ref[0]).astype(dx_ref.dtype)
+
+
+def fused_bn_act_train(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+                       *, eps: float = 1e-5, activation: str = "Mish",
+                       interpret: bool | None = None):
+    """Train-mode fused BatchNorm + activation: batch moments, normalize
+    and activation in fused passes with the ANALYTIC BN backward (see
+    `_make_fused_train`). Returns `(out, mean, var)`; mean/var are the
+    BATCH statistics for the caller's running-average update and must be
+    consumed under `stop_gradient` (the backward treats their cotangents
+    as structurally zero, exactly like flax BatchNorm's buffers).
+
+    Differentiable w.r.t. x, gamma, beta. `interpret` semantics match
+    `fused_bn_act`."""
+    if activation not in FUSED_EPILOGUE_ACTIVATIONS:
+        raise NotImplementedError(
+            "fused epilogue supports %s, got %r"
+            % (FUSED_EPILOGUE_ACTIVATIONS, activation))
+    c = x.shape[-1]
+    if gamma.shape != (c,) or beta.shape != (c,):
+        raise ValueError("gamma/beta must be (%d,), got %s/%s"
+                         % (c, gamma.shape, beta.shape))
+    use_pallas, interp = _resolve_pallas(interpret)
+    lead = x.shape[0] if x.ndim >= 3 else 1
+    rows = x.size // (lead * c)
+    x3 = x.reshape(lead, rows, c)
+    g2 = gamma.astype(jnp.float32).reshape(1, c)
+    b2 = beta.astype(jnp.float32).reshape(1, c)
+    _TRACE_SITES.append(("train", int(x.size),
+                         int(jnp.dtype(x.dtype).itemsize)))
+    fn = _make_fused_train(str(activation), float(eps), use_pallas, interp)
+    out, mean, var = fn(x3, g2, b2)
+    return out.reshape(x.shape), mean, var
+
+
+def fused_bn_act(x: jax.Array, eff_scale: jax.Array, eff_bias: jax.Array,
+                 *, activation: str = "Mish",
+                 interpret: bool | None = None) -> jax.Array:
+    """One-pass `act(x * eff_scale + eff_bias)` with a recompute backward.
+
+    x: (..., C) conv output (any float dtype; math is f32 internally);
+    eff_scale/eff_bias: (C,) — the BN-fold algebra's per-channel affine
+    (ops/quant.fold_batchnorm), from batch stats (train) or running stats
+    (eval). Differentiable w.r.t. all three.
+
+    interpret=None (production): the Pallas kernel on TPU, the pure-jnp
+    custom_vjp twin elsewhere (same math, same recompute structure — see
+    module docstring). interpret=True/False forces the Pallas path in
+    that mode (tests pin kernel parity with interpret=True).
+    """
+    if activation not in FUSED_EPILOGUE_ACTIVATIONS:
+        raise NotImplementedError(
+            "fused epilogue supports %s, got %r"
+            % (FUSED_EPILOGUE_ACTIVATIONS, activation))
+    c = x.shape[-1]
+    if eff_scale.shape != (c,) or eff_bias.shape != (c,):
+        raise ValueError(
+            "eff_scale/eff_bias must be (%d,), got %s/%s"
+            % (c, eff_scale.shape, eff_bias.shape))
+    use_pallas, interp = _resolve_pallas(interpret)
+    # (N, H, W, C) -> (N, H*W, C): merging adjacent row-major dims is a
+    # free bitcast, never an HBM copy
+    lead = x.shape[0] if x.ndim >= 3 else 1
+    rows = x.size // (lead * c)
+    x3 = x.reshape(lead, rows, c)
+    a2 = eff_scale.astype(jnp.float32).reshape(1, c)
+    b2 = eff_bias.astype(jnp.float32).reshape(1, c)
+    _TRACE_SITES.append(("eval", int(x.size),
+                         int(jnp.dtype(x.dtype).itemsize)))
+    fn = _make_fused(str(activation), use_pallas, interp)
+    return fn(x3, a2, b2).reshape(x.shape)

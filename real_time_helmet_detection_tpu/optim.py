@@ -21,9 +21,68 @@ gamma 0.1), re-designed for step-based optax schedules:
 
 from __future__ import annotations
 
+from typing import Any, Callable, NamedTuple
+
 import jax
 import jax.numpy as jnp
 import optax
+
+
+class MasterParams(NamedTuple):
+    """`--param-policy bf16-compute` optimizer state: the fp32 MASTER copy
+    of the (bf16) train params + the inner optimizer's state over it."""
+    master: Any
+    inner_opt_state: Any
+
+
+class MasterOptimizer(NamedTuple):
+    """Not an optax GradientTransformation: `update` returns the NEW
+    PARAMS directly (the bf16 re-emission of the fp32 master), because an
+    optax-style additive `updates` pytree cannot express "params :=
+    bf16(master)" exactly in bf16 arithmetic. train._optimizer_update
+    dispatches on this type."""
+    init: Callable    # params(f32) -> MasterParams
+    update: Callable  # (grads, MasterParams, params) -> (params, state)
+
+
+def with_fp32_master(inner: optax.GradientTransformation) -> MasterOptimizer:
+    """Wrap `inner` to keep the fp32 master weights INSIDE the optimizer
+    state while the TrainState carries a once-cast bf16 compute copy
+    (ISSUE 7 param-policy).
+
+    Why this shape: under the fp32 policy the per-step program recasts
+    every fp32 param to bf16 at its use sites (fwd AND bwd) — the r07
+    roofline's standalone `convert_convert_fusion` rows. Here the fwd/bwd
+    read bf16 params directly (zero param converts in the hot path); the
+    only casts left are the grad bf16->f32 on the Adam INPUT and the
+    master->bf16 re-emission on its OUTPUT, both textually adjacent to
+    the update so XLA fuses them into the Adam pass instead of separate
+    full-tree sweeps. Numerics: the grads are bit-equal to the fp32
+    policy's (the cast boundary moves, the cotangent path doesn't — see
+    tests/test_param_policy.py), and the master update itself is full
+    fp32. `init` must receive the FULL-PRECISION init params (the caller
+    casts the TrainState copy afterwards) so no mantissa is lost at
+    initialization."""
+    def init(params) -> MasterParams:
+        master = jax.tree.map(
+            lambda p: p.astype(jnp.float32)
+            if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else p,
+            params)
+        return MasterParams(master=master,
+                            inner_opt_state=inner.init(master))
+
+    def update(grads, state: MasterParams, params):
+        g32 = jax.tree.map(lambda g, m: g.astype(m.dtype), grads,
+                           state.master)
+        updates, inner_state = inner.update(g32, state.inner_opt_state,
+                                            state.master)
+        master = optax.apply_updates(state.master, updates)
+        new_params = jax.tree.map(lambda m, p: m.astype(p.dtype), master,
+                                  params)
+        return new_params, MasterParams(master=master,
+                                        inner_opt_state=inner_state)
+
+    return MasterOptimizer(init=init, update=update)
 
 
 def make_lr_schedule(cfg, steps_per_epoch: int) -> optax.Schedule:
@@ -72,13 +131,20 @@ def _inner_chain(cfg, steps_per_epoch: int) -> optax.GradientTransformation:
                        _base_optimizer(cfg, schedule))
 
 
-def build_optimizer(cfg, steps_per_epoch: int) -> optax.GradientTransformation:
-    """Construct the optax transformation from config flags."""
+def build_optimizer(cfg, steps_per_epoch: int):
+    """Construct the optax transformation from config flags. Under
+    `--param-policy bf16-compute` the base optimizer is wrapped in
+    `with_fp32_master` (a `MasterOptimizer`, not a plain
+    GradientTransformation — config.py forbids combining the policy with
+    --sub-divisions, so MultiSteps never nests with it)."""
     if cfg.sub_divisions > 1:
         return optax.MultiSteps(_inner_chain(cfg, steps_per_epoch),
                                 every_k_schedule=cfg.sub_divisions)
     schedule = make_lr_schedule(cfg, _updates_per_epoch(cfg, steps_per_epoch))
-    return _base_optimizer(cfg, schedule)
+    tx = _base_optimizer(cfg, schedule)
+    if getattr(cfg, "param_policy", "fp32") == "bf16-compute":
+        return with_fp32_master(tx)
+    return tx
 
 
 def make_accum_flush(cfg, steps_per_epoch: int):

@@ -90,17 +90,38 @@ def init_variables(model, rng: jax.Array, imsize: int):
     return variables["params"], variables.get("batch_stats", {})
 
 
+def resolve_param_policy(cfg: Config) -> str:
+    """'fp32' | 'bf16-compute' (no auto mode — the policy is a numerics
+    decision, not a backend one; config.py validates the vocabulary and
+    the --amp / --sub-divisions requirements)."""
+    return getattr(cfg, "param_policy", "fp32")
+
+
 def create_train_state(model, cfg: Config, rng: jax.Array, imsize: int,
-                       tx: optax.GradientTransformation) -> TrainState:
+                       tx) -> TrainState:
     """Initialize params/batch-stats/optimizer (≡ ref train.py:164-187
-    `load_network` fresh path)."""
+    `load_network` fresh path).
+
+    `--param-policy bf16-compute` (ISSUE 7): the optimizer state seeds
+    its fp32 MASTER from the full-precision init (optim.with_fp32_master
+    — no mantissa lost), and the TrainState carries the ONCE-cast bf16
+    compute copy; the per-step use-site recasts of the fp32 policy
+    disappear from the program. The fp32 path is textually the pre-PR
+    code (bit-identity pinned by tests/test_param_policy.py)."""
     params, batch_stats = init_variables(model, rng, imsize)
+    if resolve_param_policy(cfg) == "bf16-compute":
+        opt_state = tx.init(params)  # master = the fp32 init, exactly
+        params = jax.jit(lambda p: jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x, p))(params)
+    else:
+        opt_state = tx.init(params)
     # EMA starts as a DISTINCT copy of params (one jitted call): aliasing
     # the same buffers would make the donating train step donate them twice
     ema = (jax.jit(lambda p: jax.tree.map(jnp.copy, p))(params)
            if cfg.ema_decay > 0 else None)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                      batch_stats=batch_stats, opt_state=tx.init(params),
+                      batch_stats=batch_stats, opt_state=opt_state,
                       ema_params=ema)
 
 
@@ -171,8 +192,15 @@ def _optimizer_update(state: TrainState, tx, cfg: Config, grads,
     """Shared update tail of every train-step body: optimizer step + EMA
     stream (when --ema-decay is on) + step counter. One implementation so
     the host, device-augment and cached input paths cannot drift."""
-    updates, opt_state = tx.update(grads, state.opt_state, state.params)
-    params = optax.apply_updates(state.params, updates)
+    from .optim import MasterOptimizer
+    if isinstance(tx, MasterOptimizer):
+        # --param-policy bf16-compute: the wrapper returns the new bf16
+        # params directly (params := bf16(updated fp32 master) — the cast
+        # fuses into the Adam pass; see optim.with_fp32_master)
+        params, opt_state = tx.update(grads, state.opt_state, state.params)
+    else:
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
     ema = state.ema_params
     if cfg.ema_decay > 0 and ema is not None:
         d = cfg.ema_decay
@@ -633,7 +661,7 @@ def load_checkpoint(path: str, state: TrainState):
             raise ValueError(
                 "Checkpoint at %s does not match the current model/"
                 "optimizer configuration (--optim/--sub-divisions/"
-                "architecture): %s" % (path, e)) from e
+                "--param-policy/architecture): %s" % (path, e)) from e
     restored = raw_ckpt["state"]
     if want_ema and not disk_ema:
         # enabling EMA mid-run: seed the stream from the restored weights —

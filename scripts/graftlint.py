@@ -300,6 +300,23 @@ def _selfcheck_trace(check) -> None:
     check("quantized predict chain donation ok",
           not any(f.rule == "trace/donation" for f in qc) and not qc)
 
+    # the ISSUE-7 entry points: the bf16 param-policy scanned step (fp32
+    # master inside the optimizer state — the donation surface every
+    # mistake class loves) and the fused-epilogue predict (custom_vjp
+    # epilogue in every conv tail) must audit clean like the surfaces
+    # they replace — donation/f64/dynamic-shape included (full audit_entry
+    # incl. lowering)
+    train_bf16, targs_bf16 = ta._tiny_train_parts("none", "bf16-compute")
+    pf = ta.audit_entry(train_bf16, targs_bf16,
+                        "train_step_scanned[param=bf16-compute]",
+                        donate_argnums=(0,))
+    check("bf16-policy scanned step audits clean", not pf)
+    predict_e, variables_e, images_e = ta._tiny_predict_parts(
+        epilogue="fused")
+    ef = ta.audit_entry(lambda v, im: predict_e(v, im),
+                        (variables_e, images_e), "predict_epilogue_fused")
+    check("fused-epilogue predict audits clean", not ef)
+
 
 def selfcheck() -> int:
     t0 = time.time()

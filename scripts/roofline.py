@@ -38,10 +38,22 @@ convolutions), and times are host times; the artifact labels its platform
 and the v5e constants it classifies against. When the chip is reachable,
 run exactly the same command behind the single claim waiter (CLAUDE.md).
 
+`--diff baseline.json candidate.json` (ISSUE 7) is the attribution
+counterpart for step-compression A/Bs: it joins two roofline-v1 artifacts
+into per-op-class (conv / convert / elementwise / reduce-window / dot)
+and per-fusion byte+FLOP delta tables (schema "roofline-diff-v1"), pure
+file work — no backend is acquired. The acceptance workflow for any
+conv-path change: run the tool at the same config before and after, then
+diff; the class table says which traffic actually moved (CLAUDE.md points
+conv-path PRs here).
+
 Usage:
   python scripts/roofline.py [--platform cpu] [--batch N] [--imsize N]
       [--steps N] [--remat none|stacks|full] [--loss-kernel auto|fused|xla]
+      [--param-policy fp32|bf16-compute] [--epilogue auto|fused|xla]
       [--num-stack N] [--top N] [--no-trace] [--ab-loss-kernel]
+      [--out PATH.json] [--tag TAG]
+  python scripts/roofline.py --diff BASELINE.json CANDIDATE.json
       [--out PATH.json] [--tag TAG]
 """
 
@@ -105,6 +117,48 @@ _ELEMENTWISE_HINT = {
 }
 
 
+# the op-class taxonomy of the --diff tables (ISSUE 7): every reportable
+# row lands in exactly one class, derived from opcode + the descriptive
+# fusion names the optimized HLO carries ("convert_convert_fusion",
+# "subtract_multiply_fusion", ...). Order matters: "convolution" must be
+# tested before "convert" ("conv" is a prefix of both).
+OP_CLASSES = ("conv", "convert", "reduce-window", "dot", "elementwise")
+
+
+def op_class(name: str, opcode: str) -> str:
+    """Roofline op class of one reportable row. Classes roll up the diff
+    tables; 'elementwise' is the catch-all for the pointwise/copy/reduce
+    plumbing between the compute classes (custom-calls — Pallas kernels —
+    land there too: they replace exactly that traffic)."""
+    n = name.lower()
+    if opcode == "convolution" or "convolution" in n:
+        return "conv"
+    if opcode == "convert" or "convert" in n:
+        return "convert"
+    if opcode == "reduce-window" or "reduce-window" in n \
+            or "reduce_window" in n:
+        return "reduce-window"
+    if opcode == "dot" or n.startswith("dot"):
+        return "dot"
+    return "elementwise"
+
+
+def class_totals(rows) -> dict:
+    """Per-class byte/FLOP rollup of a fusions table (works on any
+    roofline-v1 artifact, including pre-ISSUE-7 ones whose rows carry no
+    'class' field — the class is derived from name+opcode)."""
+    out = {c: {"bytes": 0.0, "flops": 0.0, "ops": 0} for c in OP_CLASSES}
+    for r in rows:
+        c = r.get("class") or op_class(r["name"], r["opcode"])
+        out[c]["bytes"] += r["bytes"]
+        out[c]["flops"] += r["flops"]
+        out[c]["ops"] += 1
+    total = sum(v["bytes"] for v in out.values()) or 1.0
+    for v in out.values():
+        v["pct_bytes"] = round(100.0 * v["bytes"] / total, 2)
+    return out
+
+
 def _shape_bytes(dtype: str, dims: str) -> int:
     bpe = _DTYPE_BYTES.get(dtype)
     if bpe is None:
@@ -126,10 +180,10 @@ def _shape_elems(dims: str) -> int:
 
 class Instr:
     __slots__ = ("name", "opcode", "out_bytes", "operand_bytes",
-                 "out_elems", "flops", "calls", "line")
+                 "out_elems", "flops", "calls", "line", "src")
 
     def __init__(self, name, opcode, out_bytes, operand_bytes, out_elems,
-                 flops, calls, line):
+                 flops, calls, line, src=None):
         self.name = name
         self.opcode = opcode
         self.out_bytes = out_bytes
@@ -138,6 +192,7 @@ class Instr:
         self.flops = flops
         self.calls = calls
         self.line = line
+        self.src = src
 
 
 def _parse_rhs(rhs: str):
@@ -230,6 +285,11 @@ def parse_hlo(text: str):
         if m is None or current is None:
             continue
         name, rhs = m.group(1), m.group(2)
+        # provenance: op_name metadata names the python source that built
+        # the op — the analytic-substitution hook (fused epilogue) keys
+        # on it. Captured BEFORE the annotation blocks are cut.
+        sm = re.search(r'source_file="([^"]+)"', rhs)
+        src = os.path.basename(sm.group(1)) if sm else None
         # cut trailing annotation blocks whose payload can contain
         # bracketed text that would pollute the operand-shape scan
         body = re.split(r",\s*(?:metadata=|backend_config=|sharding=)",
@@ -251,18 +311,31 @@ def parse_hlo(text: str):
             appliers.add(am.group(1))
         flops = _instr_flops(opcode, body, out_elems)
         comps[current].append(Instr(name, opcode, out_bytes, opnd_bytes,
-                                    out_elems, flops, calls, body))
+                                    out_elems, flops, calls, body, src))
     return comps, fusion_bodies, appliers
 
 
 def attribute(comps, fusion_bodies, appliers):
     """Reportable per-op records: every instruction of every computation
     that is not a fusion body or scalar applier, with fusion FLOPs rolled
-    up from their called computations."""
+    up from their called computations.
+
+    Fusion provenance: the fusion INSTRUCTION usually carries no
+    metadata; its source (`src`) is the majority source_file over the
+    called computation's instructions — what the analytic-substitution
+    hook (fused epilogue) keys on."""
     comp_flops = {
         cname: sum(i.flops for i in instrs)
         for cname, instrs in comps.items()
     }
+
+    def comp_src(cname):
+        votes = {}
+        for i in comps.get(cname, ()):
+            if i.src:
+                votes[i.src] = votes.get(i.src, 0) + 1
+        return max(votes, key=votes.get) if votes else None
+
     rows = []
     for cname, instrs in comps.items():
         if cname in fusion_bodies or cname in appliers:
@@ -272,13 +345,19 @@ def attribute(comps, fusion_bodies, appliers):
                 continue
             flops = i.flops
             kind = i.opcode
+            src = i.src
             if i.opcode == "fusion" and i.calls:
                 flops = comp_flops.get(i.calls, 0.0)
+                src = src or comp_src(i.calls)
             bytes_ = i.out_bytes + i.operand_bytes
             if bytes_ == 0 and flops == 0:
                 continue
-            rows.append({"name": i.name, "opcode": kind,
-                         "flops": flops, "bytes": float(bytes_)})
+            row = {"name": i.name, "opcode": kind,
+                   "class": op_class(i.name, kind),
+                   "flops": flops, "bytes": float(bytes_)}
+            if src:
+                row["src"] = src
+            rows.append(row)
     return rows
 
 
@@ -354,7 +433,9 @@ def build_step(jax, args, loss_kernel: str):
     cfg = Config(num_stack=args.num_stack, hourglass_inch=args.hourglass_inch,
                  num_cls=2, batch_size=args.batch, amp=True,
                  imsize=args.imsize, remat=args.remat,
-                 loss_kernel=loss_kernel)
+                 loss_kernel=loss_kernel,
+                 param_policy=getattr(args, "param_policy", "fp32"),
+                 epilogue=getattr(args, "epilogue", "auto"))
     model = build_model(cfg, dtype=jnp.bfloat16)
     tx = build_optimizer(cfg, 100)
     state = create_train_state(model, cfg, jax.random.key(0), args.imsize,
@@ -363,8 +444,13 @@ def build_step(jax, args, loss_kernel: str):
     arrs = tuple(jnp.asarray(a) for a in synthetic_target_batch(
         args.batch, args.imsize, pos_rate=0.01))
     train_n = make_scanned_train_fn(body, args.steps)
+    # site registry: capture ONLY the timed program's epilogue calls
+    # (model.init above also traces the module, in eval mode)
+    from real_time_helmet_detection_tpu.ops.pallas import epilogue as _epi
+    _epi.reset_site_registry()
     compiled = jax.jit(train_n, donate_argnums=(0,)).lower(
         state, *arrs).compile()
+    build_step.epilogue_sites = _epi.traced_sites()
     remake = lambda: create_train_state(  # noqa: E731 — donation refills
         model, cfg, jax.random.key(0), args.imsize, tx)
     return compiled, state, arrs, remake
@@ -423,6 +509,203 @@ def loss_subprogram_cost(jax, args, kernel: str):
     return rec
 
 
+def substitute_epilogue_analytic(rows, sites):
+    """Off-TPU, a `--epilogue fused` model compiles the jnp custom_vjp
+    TWIN (ops/pallas/epilogue.py) — a faithful stand-in for semantics and
+    tests, but NOT the program the chip runs: the twin pays CPU-pipeline
+    taxes (materialized f32 views, Gram-dot reduction reads) that the
+    Pallas kernels keep in VMEM/registers. Exactly like
+    `loss_subprogram_cost`'s `kernel_bytes_analytic` (the r07 counting
+    model's documented basis for Pallas paths), the twin's rows —
+    identified by their HLO `source_file` metadata — are replaced by the
+    REAL kernel sequence's operand+result bytes per traced call site
+    (`epilogue.site_kernel_bytes`: train = 8 activation-sized transfers,
+    eval = 2). Twin rows whose fusion roots carry other source metadata
+    stay counted (conservative: overcounts the candidate). Returns
+    (rows, info|None); info rides in the artifact as
+    `epilogue_counting` so the basis is always visible."""
+    from real_time_helmet_detection_tpu.ops.pallas.epilogue import \
+        site_kernel_bytes
+    twin = [r for r in rows if r.get("src") == "epilogue.py"]
+    if not twin or not sites:
+        return rows, None
+    kept = [r for r in rows if r.get("src") != "epilogue.py"]
+    for i, (kind, elems, itemsize) in enumerate(sites):
+        kept.append({
+            "name": "fused_epilogue.%d" % i, "opcode": "custom-call",
+            "class": "elementwise", "src": "epilogue.py",
+            # ~20 f32 ops/element across the 4 passes (act + derivative
+            # recompute); byte-bound either way
+            "flops": 20.0 * elems,
+            "bytes": site_kernel_bytes(kind, elems, itemsize)})
+    info = {"basis": "analytic",
+            "twin_rows_dropped": len(twin),
+            "twin_rows_bytes": sum(r["bytes"] for r in twin),
+            "kernel_bytes_analytic": sum(
+                site_kernel_bytes(k, e, s) for k, e, s in sites),
+            "sites": len(sites)}
+    return kept, info
+
+
+DIFF_SCHEMA = "roofline-diff-v1"
+
+
+def diff_rooflines(baseline: dict, candidate: dict) -> dict:
+    """Join two roofline-v1 artifacts into byte/FLOP delta tables.
+
+    Pure dict work (tests pin it on checked-in fixture tables). Per-class
+    deltas are the headline — instruction names rarely survive a program
+    change, so per-fusion deltas are only reported for names present on
+    BOTH sides, plus each side's top unmatched movers. Sign convention:
+    positive delta_pct = the candidate REDUCED that class's bytes."""
+    for side, art in (("baseline", baseline), ("candidate", candidate)):
+        if art.get("schema") != SCHEMA:
+            raise ValueError("--diff: %s is not a %s artifact (schema=%r)"
+                             % (side, SCHEMA, art.get("schema")))
+    rows_a, rows_b = baseline["fusions"], candidate["fusions"]
+    cls_a, cls_b = class_totals(rows_a), class_totals(rows_b)
+    total_a = sum(v["bytes"] for v in cls_a.values())
+    total_b = sum(v["bytes"] for v in cls_b.values())
+
+    def pct(delta, base):
+        return round(100.0 * delta / base, 2) if base else None
+
+    by_class = {}
+    for c in OP_CLASSES:
+        a, b = cls_a[c], cls_b[c]
+        by_class[c] = {
+            "bytes_baseline": a["bytes"], "bytes_candidate": b["bytes"],
+            "bytes_delta": a["bytes"] - b["bytes"],
+            "bytes_delta_pct": pct(a["bytes"] - b["bytes"], a["bytes"]),
+            "flops_baseline": a["flops"], "flops_candidate": b["flops"],
+            "ops_baseline": a["ops"], "ops_candidate": b["ops"],
+            "pct_of_step_baseline": a["pct_bytes"],
+            "pct_of_step_candidate": b["pct_bytes"],
+        }
+    nonconv_a = total_a - cls_a["conv"]["bytes"]
+    nonconv_b = total_b - cls_b["conv"]["bytes"]
+    ce_a = cls_a["convert"]["bytes"] + cls_a["elementwise"]["bytes"]
+    ce_b = cls_b["convert"]["bytes"] + cls_b["elementwise"]["bytes"]
+
+    named_a = {r["name"]: r for r in rows_a}
+    named_b = {r["name"]: r for r in rows_b}
+    matched = []
+    for name in set(named_a) & set(named_b):
+        da = named_a[name]["bytes"] - named_b[name]["bytes"]
+        if da:
+            matched.append({
+                "name": name, "class": op_class(name,
+                                                named_a[name]["opcode"]),
+                "bytes_baseline": named_a[name]["bytes"],
+                "bytes_candidate": named_b[name]["bytes"],
+                "bytes_delta": da})
+    matched.sort(key=lambda r: -abs(r["bytes_delta"]))
+
+    def top_unmatched(rows, other_names):
+        un = [r for r in rows if r["name"] not in other_names]
+        un.sort(key=lambda r: -r["bytes"])
+        return [{"name": r["name"],
+                 "class": op_class(r["name"], r["opcode"]),
+                 "bytes": r["bytes"]} for r in un[:15]]
+
+    return {
+        "schema": DIFF_SCHEMA,
+        "baseline": {"config": baseline.get("config"),
+                     "platform": baseline.get("platform"),
+                     "total_bytes": total_a},
+        "candidate": {"config": candidate.get("config"),
+                      "platform": candidate.get("platform"),
+                      "total_bytes": total_b},
+        "platform_match": baseline.get("platform")
+        == candidate.get("platform"),
+        "total_bytes_delta_pct": pct(total_a - total_b, total_a),
+        "nonconv_bytes_baseline": nonconv_a,
+        "nonconv_bytes_candidate": nonconv_b,
+        "nonconv_bytes_delta_pct": pct(nonconv_a - nonconv_b, nonconv_a),
+        "convert_plus_elementwise_baseline": ce_a,
+        "convert_plus_elementwise_candidate": ce_b,
+        "convert_plus_elementwise_delta_pct": pct(ce_a - ce_b, ce_a),
+        "conv_bytes_delta_pct": pct(
+            cls_a["conv"]["bytes"] - cls_b["conv"]["bytes"],
+            cls_a["conv"]["bytes"]),
+        "by_class": by_class,
+        "matched_fusions": matched[:30],
+        "top_baseline_only": top_unmatched(rows_a, set(named_b)),
+        "top_candidate_only": top_unmatched(rows_b, set(named_a)),
+    }
+
+
+def _diff_markdown(d: dict) -> str:
+    lines = ["# Roofline diff — per-op-class HBM bytes",
+             "",
+             "baseline: %s  candidate: %s" % (
+                 json.dumps(d["baseline"]["config"]),
+                 json.dumps(d["candidate"]["config"])),
+             "",
+             "| class | baseline MB | candidate MB | delta MB | delta % | "
+             "% of step (base -> cand) |",
+             "|---|---|---|---|---|---|"]
+    for c in OP_CLASSES:
+        r = d["by_class"][c]
+        lines.append("| %s | %.1f | %.1f | %.1f | %s | %.1f -> %.1f |" % (
+            c, r["bytes_baseline"] / 2**20, r["bytes_candidate"] / 2**20,
+            r["bytes_delta"] / 2**20,
+            "%.1f" % r["bytes_delta_pct"]
+            if r["bytes_delta_pct"] is not None else "-",
+            r["pct_of_step_baseline"], r["pct_of_step_candidate"]))
+    lines += ["",
+              "total: %.1f%%  non-conv: %.1f%%  convert+elementwise: "
+              "%.1f%%  conv: %s%%  (positive = candidate moves fewer "
+              "bytes)" % (
+                  d["total_bytes_delta_pct"] or 0.0,
+                  d["nonconv_bytes_delta_pct"] or 0.0,
+                  d["convert_plus_elementwise_delta_pct"] or 0.0,
+                  d["conv_bytes_delta_pct"]),
+              "",
+              "## Top matched-fusion movers", "",
+              "| fusion | class | baseline MB | candidate MB |",
+              "|---|---|---|---|"]
+    for r in d["matched_fusions"][:15]:
+        lines.append("| %s | %s | %.2f | %.2f |" % (
+            r["name"][:48], r["class"], r["bytes_baseline"] / 2**20,
+            r["bytes_candidate"] / 2**20))
+    return "\n".join(lines) + "\n"
+
+
+def run_diff(args) -> None:
+    """--diff entry: pure file work, NO backend acquisition (a diff must
+    run on a box whose relay is down — that is its whole point)."""
+    base_path, cand_path = args.diff
+    with open(base_path) as f:
+        baseline = json.load(f)
+    with open(cand_path) as f:
+        candidate = json.load(f)
+    d = diff_rooflines(baseline, candidate)
+    d["inputs"] = {"baseline": base_path, "candidate": cand_path}
+    if not d["platform_match"]:
+        log("WARNING: diffing across platforms (%s vs %s) — fusion "
+            "choices differ by pipeline, read the class table as a trend"
+            % (baseline.get("platform"), candidate.get("platform")))
+    if args.out:
+        out_path = args.out
+    else:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        tag = ("_" + args.tag) if args.tag else ""
+        out_path = os.path.join(root, "artifacts", graft_round(),
+                                "roofline", "roofline_diff%s.json" % tag)
+    from real_time_helmet_detection_tpu.utils import (atomic_write_bytes,
+                                                      save_json)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    save_json(out_path, d, indent=1)
+    atomic_write_bytes(out_path.rsplit(".", 1)[0] + ".md",
+                       _diff_markdown(d).encode())
+    log("wrote %s" % out_path)
+    print(json.dumps({k: v for k, v in d.items()
+                      if k not in ("matched_fusions", "top_baseline_only",
+                                   "top_candidate_only", "by_class")}
+                     | {"out": out_path}))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--platform", default="",
@@ -437,6 +720,13 @@ def main() -> None:
                     choices=["none", "stacks", "full"])
     ap.add_argument("--loss-kernel", default="auto",
                     choices=["auto", "fused", "xla"])
+    ap.add_argument("--param-policy", default="fp32",
+                    choices=["fp32", "bf16-compute"])
+    ap.add_argument("--epilogue", default="auto",
+                    choices=["auto", "fused", "xla"])
+    ap.add_argument("--diff", nargs=2, metavar=("BASELINE", "CANDIDATE"),
+                    help="join two roofline-v1 artifacts into per-class "
+                         "delta tables (no backend; see module docstring)")
     ap.add_argument("--top", type=int, default=30)
     ap.add_argument("--no-trace", action="store_true",
                     help="skip the profiler run (cost-only attribution)")
@@ -449,6 +739,10 @@ def main() -> None:
     ap.add_argument("--tag", default="")
     ap.add_argument("--cpu", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.diff:
+        run_diff(args)
+        return
 
     if args.platform:
         import jax
@@ -481,6 +775,18 @@ def main() -> None:
     rows = attribute(comps, fusion_bodies, appliers)
     log("HLO: %d computations, %d reportable ops"
         % (len(comps), len(rows)))
+    epilogue_counting = None
+    if platform != "tpu":
+        # fused-epilogue analytic basis off-TPU (see the function's
+        # docstring); on TPU the Pallas custom-calls are counted natively
+        rows, epilogue_counting = substitute_epilogue_analytic(
+            rows, getattr(build_step, "epilogue_sites", []))
+        if epilogue_counting:
+            log("fused epilogue counted analytically: %d sites, twin "
+                "rows %.2f GB -> kernels %.2f GB"
+                % (epilogue_counting["sites"],
+                   epilogue_counting["twin_rows_bytes"] / 1e9,
+                   epilogue_counting["kernel_bytes_analytic"] / 1e9))
 
     durations = None
     trace_note = "disabled (--no-trace)"
@@ -505,6 +811,9 @@ def main() -> None:
             log(trace_note)
 
     summary = classify(rows, peak, hbm, durations, steps=args.steps)
+    # per-op-class rollup (the --diff tables join on these classes; also
+    # the counting model behind bench.py's convert_bytes_pct)
+    summary["by_class"] = class_totals(rows)
     meta = {
         "schema": SCHEMA,
         "platform": platform,
@@ -514,12 +823,14 @@ def main() -> None:
         "config": {"batch": args.batch, "imsize": args.imsize,
                    "num_stack": args.num_stack, "steps": args.steps,
                    "remat": args.remat, "loss_kernel": args.loss_kernel,
-                   "amp": True},
+                   "param_policy": args.param_policy,
+                   "epilogue": args.epilogue, "amp": True},
         "totals": {"flops": total_flops,
                    "cost_analysis_bytes": total_bytes_ca,
                    "parsed_bytes": summary["total_bytes"]},
         "trace": trace_note,
         "summary": summary,
+        "epilogue_counting": epilogue_counting,
         "note": ("bytes are operand+result buffer sizes of the optimized "
                  "HLO's reportable ops (fusion-internal temporaries "
                  "excluded); on cpu they reflect the host pipeline's "

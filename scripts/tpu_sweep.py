@@ -19,12 +19,18 @@ every single config — a killed run loses at most the in-flight config —
 and `--only <section>[,<section>]` reruns just the missing sections
 (inference, train, stack2, remat, stack4_768, step_grid).
 
-`step_grid` (ISSUE 2) is the (batch x remat x loss-kernel) matrix that
-picks the step-compression default: batches {16, 32, 64} x --remat
-{none, stacks, full} x --loss-kernel {xla, fused}, flagship 512^2
-num_stack=1 bf16. The record with the best img/s that compiled lands in
-`step_grid_selected`. On-chip etiquette: queue this behind the single
-claim waiter (CLAUDE.md); each config flushes before the next compiles.
+`step_grid` (ISSUE 2, grown by ISSUE 7) is the (batch x remat x
+loss-kernel x param-policy x epilogue) matrix that picks the
+step-compression default: batches {16, 32, 64} x --remat {none, stacks,
+full} x --loss-kernel {xla, fused} at the fp32/xla baseline, plus the
+ISSUE-7 lever cells (--param-policy bf16-compute and --epilogue fused,
+alone and together) per batch, flagship 512^2 num_stack=1 bf16. The
+record with the best img/s that compiled lands in `step_grid_selected` —
+the artifact `--preset sweep-best` (config.py) promotes to the default
+train flags once committed. Cells resume individually (a mid-sweep kill
+re-measures only failed/missing cells, even under `--only step_grid`).
+On-chip etiquette: queue this behind the single claim waiter (CLAUDE.md);
+each config flushes before the next compiles.
 """
 
 from __future__ import annotations
@@ -259,11 +265,13 @@ def main() -> None:
         return rec
 
     def bench_train(num_stack, batch, n, remat, imsize_=None,
-                    loss_kernel="auto"):
+                    loss_kernel="auto", param_policy="fp32",
+                    epilogue="auto"):
         sz = imsize_ or imsize
         cfg = Config(num_stack=num_stack, hourglass_inch=128, num_cls=2,
                      batch_size=batch, amp=True, imsize=sz, remat=remat,
-                     loss_kernel=loss_kernel)
+                     loss_kernel=loss_kernel, param_policy=param_policy,
+                     epilogue=epilogue)
         model = build_model(cfg, dtype=jnp.bfloat16)
         tx = build_optimizer(cfg, 100)
         state = create_train_state(model, cfg, jax.random.key(0), sz, tx)
@@ -284,11 +292,14 @@ def main() -> None:
         # give the donated input an aliasing target, not to be fetched
         dt = timed_fetch(lambda *a: compiled(*a)[1], (state, *arrs),
                          overhead, repeats=1)
+        from real_time_helmet_detection_tpu.models import resolve_epilogue
         from real_time_helmet_detection_tpu.train import resolve_loss_kernel
         from bench import bytes_of
         rec = {"batch": batch, "remat": cfg.remat, "imsize": sz,
                "num_stack": num_stack,
                "loss_kernel": resolve_loss_kernel(cfg),
+               "param_policy": cfg.param_policy,
+               "epilogue": resolve_epilogue(cfg),
                "img_per_sec_chip": round(batch * n / dt, 1),
                "step_ms": round(dt / n * 1e3, 3),
                "compile_s": round(compile_s, 1)}
@@ -436,31 +447,69 @@ def main() -> None:
     # big-batch remat=none cells are EXPECTED to OOM — that is the datum
     # that makes remat the batch-32/64 enabler, recorded not skipped.)
     if want("step_grid"):
+        # Cells are (batch, remat, loss_kernel, param_policy, epilogue).
+        # The ISSUE-2 (batch x remat x loss-kernel) matrix keeps its
+        # explicit epilogue="xla" baseline cells; the ISSUE-7 axes ride as
+        # a focused sub-grid (each new lever alone + both together, per
+        # batch) rather than the full 108-cell cross product — the levers
+        # are byte-additive, not interacting, per the roofline class
+        # tables.
         if on_tpu:
-            grid = [(b, r, k)
+            grid = [(b, r, k, "fp32", "xla")
                     for b in (16, 32, 64)
                     for r in ("none", "stacks", "full")
                     for k in ("xla", "fused")]
+            grid += [(b, "none", "fused", pp, epi)
+                     for b in (16, 32, 64)
+                     for pp, epi in (("bf16-compute", "xla"),
+                                     ("fp32", "fused"),
+                                     ("bf16-compute", "fused"))]
         else:
-            grid = [(2, "none", "xla"), (2, "stacks", "fused"),
-                    (2, "full", "fused")]
-        for batch, remat, kernel in grid:
+            grid = [(2, "none", "xla", "fp32", "xla"),
+                    (2, "stacks", "fused", "fp32", "xla"),
+                    (2, "full", "fused", "fp32", "xla"),
+                    (2, "none", "xla", "bf16-compute", "xla"),
+                    (2, "none", "xla", "fp32", "fused"),
+                    (2, "none", "xla", "bf16-compute", "fused")]
+        # per-cell resume (the int8 section's pattern): successful cells
+        # from the prior run survive a mid-sweep kill even under
+        # `--only step_grid` — only failed/missing cells re-measure
+        prior_cells = [r for r in (prior or {}).get("step_grid", [])
+                       if "img_per_sec_chip" in r]
+        for r in prior_cells:
+            if r not in results["step_grid"]:
+                results["step_grid"].append(r)
+        done = {(r.get("batch"), r.get("remat"), r.get("loss_kernel"),
+                 r.get("param_policy", "fp32"), r.get("epilogue", "xla"))
+                for r in results["step_grid"] if "img_per_sec_chip" in r}
+        for batch, remat, kernel, policy, epilogue in grid:
+            # grid cells are fully explicit (no "auto"), so the raw tuple
+            # matches the resolved fields bench_train records
+            cell = (batch, remat, kernel, policy, epilogue)
+            if cell in done:
+                log("step_grid %s already measured; skipping" % (cell,))
+                continue
             n = max(8, min(64, 1024 // batch)) if on_tpu else 2
             try:
                 rec = bench_train(1, batch, n, remat=remat,
-                                  loss_kernel=kernel)
+                                  loss_kernel=kernel, param_policy=policy,
+                                  epilogue=epilogue)
                 results["step_grid"].append(rec)
-                log("step_grid b=%d remat=%s loss=%s: %s"
-                    % (batch, remat, kernel, rec))
+                log("step_grid b=%d remat=%s loss=%s pp=%s epi=%s: %s"
+                    % (batch, remat, kernel, policy, epilogue, rec))
             except Exception as e:  # noqa: BLE001
                 results["step_grid"].append(
                     {"batch": batch, "remat": remat, "loss_kernel": kernel,
+                     "param_policy": policy, "epilogue": epilogue,
                      "error": str(e).splitlines()[-1][:200]})
-                log("step_grid b=%d remat=%s loss=%s FAILED: %r"
-                    % (batch, remat, kernel, e))
+                log("step_grid b=%d remat=%s loss=%s pp=%s epi=%s "
+                    "FAILED: %r" % (batch, remat, kernel, policy,
+                                    epilogue, e))
             flush()
         ok = [r for r in results["step_grid"] if "img_per_sec_chip" in r]
         if ok:
+            # the record `--preset sweep-best` promotes to default train
+            # flags (config.sweep_best_overrides reads the committed pick)
             results["step_grid_selected"] = max(
                 ok, key=lambda r: r["img_per_sec_chip"])
             log("step_grid selected: %s" % results["step_grid_selected"])

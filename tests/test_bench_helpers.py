@@ -363,3 +363,41 @@ def test_save_json_and_pickle_are_atomic(tmp_path):
 
     leftovers = [n for n in os.listdir(str(tmp_path)) if ".tmp." in n]
     assert leftovers == []
+
+
+def test_find_last_tpu_result_carries_step_policy_fields(tmp_path):
+    """ISSUE 7 satellite: param_policy/epilogue ride find_last_tpu_result
+    (the A/B labels without which a carried-forward train number is
+    uninterpretable); convert_bytes_pct is per-run attribution and
+    deliberately does NOT ride."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r09", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1250.0,
+        "mfu_train": 0.61, "param_policy": "bf16-compute",
+        "epilogue": "fused", "convert_bytes_pct": 4.2})
+    got = bench.find_last_tpu_result(root)
+    assert got["param_policy"] == "bf16-compute"
+    assert got["epilogue"] == "fused"
+    assert "convert_bytes_pct" not in got
+    assert got["value"] == 1250.0
+
+
+def test_find_last_tpu_result_old_lines_lack_policy_keys(tmp_path):
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r05", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1100.0})
+    got = bench.find_last_tpu_result(root)
+    assert "param_policy" not in got and "epilogue" not in got
+
+
+def test_sweep_step_grid_cell_identity_fields():
+    """The step_grid per-cell resume keys on (batch, remat, loss_kernel,
+    param_policy, epilogue); a prior record missing the new fields (a
+    pre-ISSUE-7 sweep.json) must default to the fp32/xla baseline cell
+    rather than colliding with a lever cell."""
+    rec_old = {"batch": 16, "remat": "none", "loss_kernel": "xla",
+               "img_per_sec_chip": 400.0}
+    key = (rec_old.get("batch"), rec_old.get("remat"),
+           rec_old.get("loss_kernel"), rec_old.get("param_policy", "fp32"),
+           rec_old.get("epilogue", "xla"))
+    assert key == (16, "none", "xla", "fp32", "xla")

@@ -104,6 +104,96 @@ def test_roofline_ab_loss_kernel_recorded(tmp_path):
     assert "step_bytes_delta_pct_projected" in ab
 
 
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+
+
+def test_op_class_taxonomy():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from roofline import OP_CLASSES, op_class
+    assert op_class("convolution.5", "convolution") == "conv"
+    # fusion names carry the class ("conv" prefix must not shadow
+    # "convert" or vice versa)
+    assert op_class("convert_convert_fusion.161", "fusion") == "convert"
+    assert op_class("loop_convolution_fusion.2", "fusion") == "conv"
+    assert op_class("convert.7", "convert") == "convert"
+    assert op_class("reduce-window.1", "reduce-window") == "reduce-window"
+    assert op_class("dot.5", "dot") == "dot"
+    assert op_class("subtract_multiply_fusion.9", "fusion") == "elementwise"
+    assert op_class("custom-call.3", "custom-call") == "elementwise"
+    assert set(OP_CLASSES) == {"conv", "convert", "reduce-window", "dot",
+                               "elementwise"}
+
+
+def test_diff_on_fixture_tables():
+    """roofline-diff-v1 over the two checked-in fixture tables: every
+    delta is hand-computable (the ISSUE-7 smoke-tier contract)."""
+    import json as _json
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from roofline import diff_rooflines
+    with open(os.path.join(FIXTURES, "roofline_fixture_baseline.json")) as f:
+        base = _json.load(f)
+    with open(os.path.join(FIXTURES,
+                           "roofline_fixture_candidate.json")) as f:
+        cand = _json.load(f)
+    d = diff_rooflines(base, cand)
+    assert d["schema"] == "roofline-diff-v1"
+    assert d["platform_match"] is True
+    # hand math: totals 2000 -> 1250; nonconv 1400 -> 650;
+    # convert+elementwise 1200 -> 450; conv unchanged
+    assert d["total_bytes_delta_pct"] == pytest.approx(37.5)
+    assert d["nonconv_bytes_delta_pct"] == pytest.approx(53.57, abs=0.01)
+    assert d["convert_plus_elementwise_delta_pct"] == pytest.approx(62.5)
+    assert d["conv_bytes_delta_pct"] == 0.0
+    assert d["by_class"]["convert"]["bytes_baseline"] == 600.0
+    assert d["by_class"]["convert"]["bytes_candidate"] == 50.0
+    assert d["by_class"]["convert"]["ops_baseline"] == 2
+    # matched per-fusion movers, largest first, zero-delta rows excluded
+    matched = d["matched_fusions"]
+    assert [r["name"] for r in matched] == ["subtract_multiply_fusion.2",
+                                            "convert.7"]
+    assert matched[0]["bytes_delta"] == 150.0
+    # each side's unmatched movers surface by bytes
+    assert d["top_baseline_only"][0]["name"] == "convert_convert_fusion.1"
+    assert d["top_candidate_only"][0]["name"] == "multiply_add_fusion.9"
+    # a non-roofline input refuses loudly
+    with pytest.raises(ValueError, match="not a roofline-v1"):
+        diff_rooflines({"schema": "bogus"}, cand)
+
+
+def test_diff_cli_writes_artifact(tmp_path):
+    """--diff is pure file work: the CLI must produce the JSON+md pair
+    without acquiring any backend (subprocess finishes in seconds)."""
+    out = tmp_path / "diff.json"
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--diff",
+         os.path.join(FIXTURES, "roofline_fixture_baseline.json"),
+         os.path.join(FIXTURES, "roofline_fixture_candidate.json"),
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    d = json.loads(out.read_text())
+    assert d["schema"] == "roofline-diff-v1"
+    assert d["convert_plus_elementwise_delta_pct"] == pytest.approx(62.5)
+    assert os.path.exists(str(out)[:-len(".json")] + ".md")
+    # the ONE JSON line contract holds for the diff mode too
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["schema"] == "roofline-diff-v1"
+
+
+def test_class_totals_derives_classes_for_legacy_rows():
+    """Pre-ISSUE-7 artifacts carry no 'class' field: the rollup (and so
+    --diff against an old baseline like r07's) must derive it."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from roofline import class_totals
+    rows = [{"name": "convert_convert_fusion.2", "opcode": "fusion",
+             "flops": 1.0, "bytes": 10.0},
+            {"name": "convolution.9", "opcode": "convolution",
+             "flops": 5.0, "bytes": 4.0}]
+    t = class_totals(rows)
+    assert t["convert"]["bytes"] == 10.0
+    assert t["conv"]["bytes"] == 4.0
+
+
 def test_hlo_parser_units():
     sys.path.insert(0, os.path.join(REPO, "scripts"))
     from roofline import attribute, parse_hlo
