@@ -79,11 +79,11 @@ DEFAULT_HBM = 819e9  # v5e
 # and runner_drive.py (they diverged in r5: mfu_breakdown defaulted to r05
 # while the rest stayed at r04, scattering same-round artifacts — ADVICE
 # r5 #3); bump it here when a new round starts, or override per-run with
-# $GRAFT_ROUND. r09 = the memory-traffic-strike round (ISSUE 7:
-# param-dtype policy + fused BN epilogue + roofline --diff); earlier
+# $GRAFT_ROUND. r10 = the continuous-batching serving round (ISSUE 8:
+# serving/ engine, serve_bench load curves, per-bucket export); earlier
 # rounds' artifact dirs are committed history and must not be
 # overwritten.
-GRAFT_ROUND_DEFAULT = "r09"
+GRAFT_ROUND_DEFAULT = "r10"
 
 # v5e int8 MXU peak (2x the bf16 peak — jax-ml scaling-book): the
 # denominator for int8-path MFU and the hardware case for --infer-dtype
@@ -240,7 +240,7 @@ def find_last_tpu_result(repo_root: str | None = None) -> dict | None:
             "mfu_train", "mfu_fwd", "device_kind", "peak_pallas_us",
             "peak_xla_us", "pallas_matches_xla", "infer_dtype", "int8_fps",
             "int8_vs_bf16", "recompile_count", "loadavg", "param_policy",
-            "epilogue")
+            "epilogue", "serve_p50_ms", "serve_p99_ms", "serve_goodput")
     out.update({k: rec[k] for k in keep if k in rec})
     return out
 
@@ -553,6 +553,45 @@ def _bench(out: dict, hb) -> None:
         except Exception as e:  # noqa: BLE001
             log("int8 bench failed: %r" % e)
         hb.beat("int8 section done")
+
+    # --- serving engine closed loop (--serve) -----------------------------
+    # A short saturation probe of the continuous-batching engine
+    # (serving/engine.py) at this bench's predict config: serve_goodput is
+    # completions/s with --serve-buckets coalescing + pipelining,
+    # serve_p50/p99 the client-side latency at saturation. The full
+    # open-loop offered-load curve is scripts/serve_bench.py's job; this
+    # section just puts the serving headline on the ONE JSON line.
+    if "--serve" in sys.argv or os.environ.get("BENCH_SERVE") == "1":
+        try:
+            from real_time_helmet_detection_tpu.serving import ServingEngine
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "scripts"))
+            from serve_bench import closed_loop
+            sbuckets = tuple(b for b in (1, 2, 4, 8, 16) if b <= batch)
+            simgs = [rng.integers(0, 256, (imsize, imsize, 3),
+                                  dtype=np.uint8) for _ in range(16)]
+            spredict = make_predict_fn(model, cfg, normalize="imagenet")
+            with tracer.span("bench:serve-compile", buckets=len(sbuckets)):
+                sengine = ServingEngine(
+                    spredict, variables, (imsize, imsize, 3), np.uint8,
+                    buckets=sbuckets, max_wait_ms=5.0, depth=2,
+                    queue_capacity=4 * batch, tracer=tracer)
+            try:
+                sengine.predict_many(simgs[:2])  # warm
+                row = closed_loop(
+                    sengine, simgs, clients=2 * batch,
+                    duration_s=float(os.environ.get("BENCH_SERVE_S", "3")),
+                    tracer=tracer)
+            finally:
+                sengine.close()
+            out["serve_p50_ms"] = row["p50_ms"]
+            out["serve_p99_ms"] = row["p99_ms"]
+            out["serve_goodput"] = row["goodput_rps"]
+            log("serve closed loop: %.1f req/s, p50 %s ms p99 %s ms"
+                % (row["goodput_rps"], row["p50_ms"], row["p99_ms"]))
+        except Exception as e:  # noqa: BLE001
+            log("serve bench failed: %r" % e)
+        hb.beat("serve section done")
 
     # --- train-step throughput + MFU(train) -------------------------------
     try:

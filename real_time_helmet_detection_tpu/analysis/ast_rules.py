@@ -43,6 +43,16 @@ analogue is manual code review, ref /root/reference/README.md:1):
                           artifact) and lands in the round's span log when
                           $OBS_SPAN_LOG is set. The sanctioned bench
                           timing harness is allowlisted.
+* `device-get-in-serving-loop` — a device fetch inside a loop in the
+                          serving package anywhere but the engine's ONE
+                          sanctioned batched fetch point: a per-request
+                          `device_get` in a serving hot loop serializes
+                          the pipeline (one host<->device sync per
+                          REQUEST, ~70 ms each on the tunnel) — exactly
+                          the failure continuous batching exists to
+                          amortize. Results must ride the per-BATCH D2H
+                          (`ServingEngine._fetch_loop`, the allowlisted
+                          completion point).
 
 Suppression: a `# graftlint: off=<rule>[,<rule>]` comment anywhere inside
 the flagged node's line span disables that rule there — every suppression
@@ -84,6 +94,19 @@ DEVICE_GET_LOOP_ALLOW = {
     # deferred loss flush every print_interval steps + epoch-boundary
     # scalar fetches — the documented alternative to a per-step sync
     "real_time_helmet_detection_tpu/train.py",
+    # the serving engine's batched fetch loop is the designed completion
+    # point of the in-flight pipeline; the STRICTER serving-specific rule
+    # below (device-get-in-serving-loop) polices this package instead,
+    # allowing only that one fetch point
+    "real_time_helmet_detection_tpu/serving/engine.py",
+}
+# the serving package's ONE sanctioned fetch point: the depth-pipelined
+# per-BATCH D2H (everything else in serving/ that fetches in a loop is a
+# per-request sync bug)
+SERVING_PREFIX = "real_time_helmet_detection_tpu/serving/"
+SERVING_FETCH_ALLOW = {
+    "real_time_helmet_detection_tpu/serving/engine.py::"
+    "ServingEngine._fetch_loop",
 }
 RAW_WRITE_ALLOW = {
     # the atomic-write implementation itself
@@ -417,9 +440,51 @@ def rule_raw_span_timing(tree, lines, relpath) -> List[Finding]:
     return out
 
 
+def rule_device_get_in_serving_loop(tree, lines, relpath) -> List[Finding]:
+    """Per-request fetches in serving hot loops (ISSUE 8 satellite). Scope
+    is the serving package; the engine's single batched fetch point is
+    allowlisted (SERVING_FETCH_ALLOW) — anything else that fetches inside
+    a loop is syncing per request and defeats the pipeline."""
+    if not relpath.startswith(SERVING_PREFIX):
+        return []
+    out = []
+    for qual, node, body in _iter_scopes(tree):
+        if "%s::%s" % (relpath, qual) in SERVING_FETCH_ALLOW:
+            continue
+        stack: List[ast.AST] = list(body)
+        loops: List[ast.AST] = []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(n, (ast.For, ast.While)):
+                loops.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        for loop in loops:
+            for call in _scope_calls(loop.body):
+                if _call_name(call).split(".")[-1] not in _FETCH_ATTRS:
+                    continue
+                if _suppressed("device-get-in-serving-loop", lines,
+                               call.lineno,
+                               getattr(call, "end_lineno", call.lineno)):
+                    continue
+                out.append(Finding(
+                    rule="ast/device-get-in-serving-loop", path=relpath,
+                    line=call.lineno, context=qual,
+                    message="device fetch inside a serving loop outside "
+                            "the engine's batched fetch point: a "
+                            "per-request sync (~70 ms tunnel round trip "
+                            "each) serializes the pipeline — return "
+                            "futures and let ServingEngine._fetch_loop's "
+                            "per-batch D2H complete them"))
+    return out
+
+
 RULES = (rule_per_call_timing, rule_queue_bypass, rule_env_platform_write,
          rule_raw_artifact_write, rule_device_get_in_loop,
-         rule_missing_ref_citation, rule_raw_span_timing)
+         rule_missing_ref_citation, rule_raw_span_timing,
+         rule_device_get_in_serving_loop)
 
 
 # ---------------------------------------------------------------------------

@@ -320,6 +320,25 @@ def _tiny_predict_int8_parts():
     return predict, variables, images
 
 
+# The serve bucket set audited per bucket (ISSUE 8): tiny-shape stand-ins
+# for serving.resolve_buckets' default — every bucket the engine
+# AOT-compiles is its own entry point (the whole set must obey the
+# dynamic-shape/f64/donation rules, not just the eval batch shape).
+SERVE_BUCKETS_AUDIT = (1, 2, 4)
+
+
+def _tiny_serve_parts(bucket: int):
+    """One serve bucket's program at audit shapes: the raw-uint8 wire
+    predict (the engine's ingress contract) at batch size `bucket` —
+    exactly what `ServingEngine.__init__` lowers per bucket."""
+    import numpy as np
+
+    predict, variables, _ = _tiny_predict_parts(normalize="imagenet")
+    images = np.zeros((bucket, _TINY["imsize"], _TINY["imsize"], 3),
+                      np.uint8)
+    return predict, variables, images
+
+
 def _predict_chain(predict, n: int = 2):
     """bench.py's donating predict-chain contract (make_predict_chain):
     images donated, final carry returned as the aliasing target."""
@@ -460,6 +479,25 @@ def audit_repo_entry_points(lower: bool = True) -> List[Finding]:
         findings.append(Finding(
             rule="trace/trace-failure", path="<predict_int8>",
             context="predict_int8",
+            message="entry construction failed: %s: %s"
+                    % (type(e).__name__,
+                       (str(e).splitlines() or ["?"])[0][:200])))
+
+    try:
+        # the serving engine's bucket set (ISSUE 8): every bucket is a
+        # separately-compiled production program — audit each one (the
+        # raw-uint8 serve wire), not just the eval batch shape
+        for b in SERVE_BUCKETS_AUDIT:
+            entry = "serve_predict[b=%d]" % b
+            predict_s, variables_s, images_s = _tiny_serve_parts(b)
+            findings += audit_entry(
+                lambda v, im, _p=predict_s: _p(v, im),
+                (variables_s, images_s), entry,
+                lower=lower and b == SERVE_BUCKETS_AUDIT[0])
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            rule="trace/trace-failure", path="<serve_predict>",
+            context="serve_predict",
             message="entry construction failed: %s: %s"
                     % (type(e).__name__,
                        (str(e).splitlines() or ["?"])[0][:200])))

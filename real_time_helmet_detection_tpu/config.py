@@ -126,6 +126,26 @@ class Config:
     calib_percentile: float = 100.0  # activation clip statistic: 100 =
     # abs-max, <100 = that upper percentile of |x| (outlier-robust)
 
+    # serving (ISSUE 8: the continuous-batching engine, serving/engine.py)
+    serve_buckets: List[int] = field(
+        default_factory=lambda: [1, 2, 4, 8, 16])  # static batch buckets:
+    # every bucket is AOT-compiled once at engine construction and a
+    # request batch takes the smallest bucket >= its size. ONE set shared
+    # by the engine, export's per-bucket StableHLO artifacts and
+    # graftlint's per-bucket trace audit (serving.resolve_buckets).
+    serve_max_wait_ms: float = 5.0  # batch-formation policy: dispatch when
+    # the largest bucket fills OR this long after the oldest queued
+    # request arrived, whichever first (0 = never wait — latency-first)
+    serve_depth: int = 2          # max in-flight batches (H2D/compute/D2H
+    # pipelining depth; bounds device memory at `depth` batches) — the
+    # engine generalization of the C++ runner's --depth loop
+    serve_queue: int = 128        # admission bound: queued-but-unbatched
+    # requests beyond this are shed (non-blocking submitters) or apply
+    # backpressure (blocking submitters, e.g. the eval driver)
+    export_serve: bool = False    # export additionally emits one StableHLO
+    # artifact per serve bucket (out_dir/serving/b<N>/) so the C++ runner
+    # can serve the same bucket set the Python engine does
+
     # augmentation
     crop_percent: List[float] = field(default_factory=lambda: [0.0, 0.1])
     color_multiply: List[float] = field(default_factory=lambda: [1.2, 1.5])
@@ -306,6 +326,20 @@ class Config:
         if not 0.0 < self.calib_percentile <= 100.0:
             raise ValueError("--calib-percentile must be in (0, 100], "
                              "got %r" % (self.calib_percentile,))
+        if not self.serve_buckets or any(int(b) < 1
+                                         for b in self.serve_buckets):
+            raise ValueError("--serve-buckets must be a non-empty list of "
+                             "positive batch sizes, got %r"
+                             % (self.serve_buckets,))
+        if self.serve_max_wait_ms < 0:
+            raise ValueError("--serve-max-wait-ms must be >= 0, got %r"
+                             % (self.serve_max_wait_ms,))
+        if self.serve_depth < 1:
+            raise ValueError("--serve-depth must be >= 1, got %d"
+                             % self.serve_depth)
+        if self.serve_queue < 1:
+            raise ValueError("--serve-queue must be >= 1, got %d"
+                             % self.serve_queue)
         if self.loader not in ("thread", "process"):
             raise ValueError("--loader must be 'thread' or 'process', got %r"
                              % self.loader)

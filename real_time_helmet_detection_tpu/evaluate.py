@@ -29,10 +29,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import Config
-from .data import (CLASS2COLOR, INDEX2CLASS, BatchLoader, DevicePrefetcher,
-                   StagedBatch, TestAugmentor, VOCDataset, load_dataset)
+from .data import (CLASS2COLOR, INDEX2CLASS, BatchLoader, TestAugmentor,
+                   VOCDataset, load_dataset)
 from .models import build_model
 from .predict import make_predict_fn
+from .serving import ServingEngine, resolve_buckets
 from .train import init_variables, resolve_model_load, restore_variables
 from .utils import (AverageMeter, draw_box, imload, save_pickle, timestamp,
                     write_text)
@@ -196,127 +197,115 @@ def evaluate(cfg: Config) -> Dict:
     results: Dict[str, Dict] = {}
     gt_boxes: Dict[str, np.ndarray] = {}
     gt_labels: Dict[str, np.ndarray] = {}
-    # "dispatch" = async predict dispatch only (not inference latency —
-    # bench.py measures that); "consume" = device_get wait + host box
-    # rescale/txt writes for the previous batch. These are host-side
-    # pipeline meters by design, not device timing (bench.py owns that):
-    # graftlint: off=per-call-timing
+    # "dispatch" = engine submit wall (async — the engine batches and
+    # dispatches in its own threads; bench.py owns device timing);
+    # "consume" = result wait + host box rescale/txt writes. Host-side
+    # pipeline meters by design: graftlint: off=per-call-timing
     meters = {k: AverageMeter() for k in ("data", "dispatch", "consume")}
 
     imsize = float(cfg.imsize or 512)
     seen = 0
 
-    def consume(dets, infos):
-        """Host-side consumption of one batch's fetched detections."""
+    def consume_row(row, info):
+        """Host-side consumption of one request's detections row."""
         nonlocal seen
         from .data.voc import boxes_from_voc_dict
-        for b, info in enumerate(infos):
-            # `or` (not a .get default): a self-closed <filename/> parses
-            # to "" since the r2 parser rewrite, which would silently make
-            # every such image_id "" (round-2 advisor finding)
-            image_id = os.path.splitext(
-                info["annotation"].get("filename") or "%06d" % seen)[0]
-            seen += 1
-            ow, oh = _origin_size(info)
-            keep = dets.valid[b]
-            boxes = dets.boxes[b][keep]
-            # augmented (imsize x imsize) -> original WxH
-            # (ref evaluate.py:100-112)
-            boxes = boxes * np.array([ow / imsize, oh / imsize,
-                                      ow / imsize, oh / imsize], np.float32)
-            classes = dets.classes[b][keep]
-            scores = dets.scores[b][keep]
-            results[image_id] = {"box": boxes, "cls": classes,
-                                 "score": scores}
-            if world == 1:
-                # multi-host defers all side effects to rank 0 after the
-                # allgather, and scores GT from the local XML files
-                write_detection_txt(txt_dir, image_id, boxes, classes,
-                                    scores)
-                gb, gl = boxes_from_voc_dict(info)
-                gt_boxes[image_id], gt_labels[image_id] = gb, gl
+        # `or` (not a .get default): a self-closed <filename/> parses
+        # to "" since the r2 parser rewrite, which would silently make
+        # every such image_id "" (round-2 advisor finding)
+        image_id = os.path.splitext(
+            info["annotation"].get("filename") or "%06d" % seen)[0]
+        seen += 1
+        ow, oh = _origin_size(info)
+        keep = row.valid
+        boxes = row.boxes[keep]
+        # augmented (imsize x imsize) -> original WxH
+        # (ref evaluate.py:100-112)
+        boxes = boxes * np.array([ow / imsize, oh / imsize,
+                                  ow / imsize, oh / imsize], np.float32)
+        classes = row.classes[keep]
+        scores = row.scores[keep]
+        results[image_id] = {"box": boxes, "cls": classes, "score": scores}
+        if world == 1:
+            # multi-host defers all side effects to rank 0 after the
+            # allgather, and scores GT from the local XML files
+            write_detection_txt(txt_dir, image_id, boxes, classes, scores)
+            gb, gl = boxes_from_voc_dict(info)
+            gt_boxes[image_id], gt_labels[image_id] = gb, gl
 
-    def host_batches():
-        """(padded images, infos) stream off the loader."""
-        for batch in loader:
-            images = batch.image
-            if images.shape[0] < cfg.batch_size:
-                # pad the final partial batch to the steady-state shape:
-                # one jitted program for the whole eval instead of a second
-                # XLA compile on the odd last shape; `infos` bounds the
-                # consumption loop so padding rows are never read
-                pad = cfg.batch_size - images.shape[0]
-                images = np.concatenate(
-                    [images,
-                     np.zeros((pad,) + images.shape[1:], images.dtype)])
-            yield images, batch.infos
-
-    iterator = host_batches()
-    if cfg.device_prefetch > 0:
-        # --device-prefetch: dispatch the sharded H2D of the next N batches
-        # while the device predicts the current one (on top of the
-        # software-pipelined consume below)
+    # The serving engine IS the eval predict path (ISSUE 8): per-image
+    # requests coalesce into fixed-shape buckets (the final partial batch
+    # simply takes a smaller AOT-compiled bucket — no host-side padding,
+    # still zero recompiles), H2D/compute/D2H of consecutive batches
+    # overlap at --serve-depth (subsuming the old one-deep pending
+    # pipeline and eval's --device-prefetch staging), and the uint8 raw
+    # wire + box-only egress are the engine's native contract. The meshed
+    # path keeps the single batch-size bucket (the batch sharding's
+    # divisibility constraint); results are bit-identical either way
+    # (per-image independence, tests/test_serving.py).
+    if mesh is not None:
         from .parallel import batch_sharding
-        sharding = batch_sharding(mesh, 4) if mesh is not None else None
+        sharding = batch_sharding(mesh, 4)
+        buckets = (cfg.batch_size,)
+    else:
+        sharding = None
+        buckets = tuple(sorted(
+            {b for b in resolve_buckets(cfg) if b <= cfg.batch_size}
+            | {cfg.batch_size}))
+    depth = max(cfg.serve_depth, 1 + cfg.device_prefetch)
+    engine = ServingEngine(
+        predict, variables, (int(imsize), int(imsize), 3), np.uint8,
+        buckets=buckets, max_wait_ms=cfg.serve_max_wait_ms, depth=depth,
+        queue_capacity=cfg.serve_queue, sharding=sharding, tracer=tracer)
 
-        def stage(item):
-            images, _ = item
-            return (jax.device_put(images, sharding)
-                    if sharding is not None else jax.device_put(images))
+    from collections import deque
+    pending: "deque" = deque()  # (futures, infos) per loader batch
 
-        iterator = DevicePrefetcher(iterator, tracer.wrap("h2d", stage),
-                                    depth=cfg.device_prefetch)
-
-    # Software-pipelined loop (same shape as the async train loop): batch
-    # i's device arrays are left un-fetched while batch i+1 is loaded and
-    # dispatched, so host work (JPEG decode, box rescale, txt writes) and
-    # device compute overlap. JAX dispatch is async — only `device_get`
-    # waits. The reference eval is strictly sequential (evaluate.py:66-97).
-    pending = None  # (un-fetched device dets, infos of that batch)
-    tic = time.time()
-    for i, item in enumerate(iterator):
-        data_t = time.time() - tic
-        meters["data"].update(data_t)
-        if tracer.enabled:
-            tracer.record("loader-wait", data_t, it=i)
+    def consume_batch(futs, infos):
         t0 = time.time()
-        if isinstance(item, StagedBatch):
-            images, infos = item.arrays, item.host[1]
-        else:
-            # numpy goes straight to the jitted fn: pjit performs the
-            # (sharded, in the meshed case) H2D itself — an explicit
-            # jnp.asarray would commit the whole batch to device 0 first
-            # and re-distribute
-            images, infos = item
-        dets_dev = predict(variables, images)  # async dispatch
-        dispatch_t = time.time() - t0
-        meters["dispatch"].update(dispatch_t)
+        for fut, info in zip(futs, infos):
+            consume_row(fut.result(), info)
+        # includes the result wait, i.e. any device time not hidden
+        # behind the host work
+        consume_t = time.time() - t0
+        meters["consume"].update(consume_t)
         if tracer.enabled:
-            tracer.record("dispatch", dispatch_t, it=i)
-        if pending is not None:
-            t0 = time.time()
-            consume(jax.device_get(pending[0]), pending[1])
-            # includes the device_get wait, i.e. any device time not hidden
-            # behind the host work
-            consume_t = time.time() - t0
-            meters["consume"].update(consume_t)
-            if tracer.enabled:
-                tracer.record("fetch", consume_t, it=i)
-        pending = (dets_dev, infos)
+            tracer.record("fetch", consume_t)
 
-        if i % max(1, cfg.print_interval // 10) == 0:
-            print("%s: eval iter %d/%d, data %.3fs dispatch %.3fs "
-                  "fetch+consume %.3fs"
-                  % (timestamp(), i, len(loader), meters["data"].avg,
-                     meters["dispatch"].avg, meters["consume"].avg),
-                  flush=True)
+    try:
         tic = time.time()
-    if pending is not None:
-        t0 = time.time()
-        consume(jax.device_get(pending[0]), pending[1])
-        meters["consume"].update(time.time() - t0)
-    if hasattr(loader, "close"):
-        loader.close()  # reap workers, unlink shared-memory slots
+        for i, batch in enumerate(loader):
+            data_t = time.time() - tic
+            meters["data"].update(data_t)
+            if tracer.enabled:
+                tracer.record("loader-wait", data_t, it=i)
+            t0 = time.time()
+            futs = [engine.submit(batch.image[j])
+                    for j in range(len(batch.infos))]
+            dispatch_t = time.time() - t0
+            meters["dispatch"].update(dispatch_t)
+            if tracer.enabled:
+                tracer.record("dispatch", dispatch_t, it=i)
+            pending.append((futs, batch.infos))
+            # drain completed heads without blocking: host work (box
+            # rescale, txt writes) overlaps the engine's device pipeline
+            while len(pending) > 1 and all(f.done()
+                                           for f in pending[0][0]):
+                consume_batch(*pending.popleft())
+
+            if i % max(1, cfg.print_interval // 10) == 0:
+                print("%s: eval iter %d/%d, data %.3fs submit %.3fs "
+                      "fetch+consume %.3fs"
+                      % (timestamp(), i, len(loader), meters["data"].avg,
+                         meters["dispatch"].avg, meters["consume"].avg),
+                      flush=True)
+            tic = time.time()
+        while pending:
+            consume_batch(*pending.popleft())
+    finally:
+        engine.close()
+        if hasattr(loader, "close"):
+            loader.close()  # reap workers, unlink shared-memory slots
     tracer.close()
 
     if world > 1:
@@ -473,12 +462,18 @@ def demo(cfg: Config) -> Dict:
                             dtype=jnp.bfloat16 if cfg.amp else None,
                             percentile=cfg.calib_percentile))
     predict = make_predict_fn(model, cfg, quant_scales=quant_scales)
-    dets = jax.device_get(predict(variables, jnp.asarray(img)))
+    # one-image serve through the engine API (bucket {1}): the demo is the
+    # smallest consumer of the same serving surface eval and the C++
+    # runner use — same program, same result bits as a direct predict
+    with ServingEngine(predict, variables, (imsize, imsize, 3),
+                       np.float32, buckets=(1,),
+                       max_wait_ms=0.0) as engine:
+        row = engine.submit(np.asarray(img)[0]).result()
 
-    keep = dets.valid[0]
-    boxes = np.clip(dets.boxes[0][keep], 0, imsize)  # clamp (ref :270)
-    classes = dets.classes[0][keep]
-    scores = dets.scores[0][keep]
+    keep = row.valid
+    boxes = np.clip(row.boxes[keep], 0, imsize)  # clamp (ref :270)
+    classes = row.classes[keep]
+    scores = row.scores[keep]
 
     pil = img_pil.resize((imsize, imsize))
     for box, c, s in zip(boxes, classes, scores):

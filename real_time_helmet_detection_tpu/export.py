@@ -150,6 +150,52 @@ def export_predict(cfg: Config, out_dir: Optional[str] = None,
     except Exception as e:  # pragma: no cover - jaxlib internals may move
         print("warning: could not write compile_options.pb:", e)
 
+    # --export-serve: one artifact per serve bucket (ISSUE 8), the SAME
+    # fused fn lowered at every batch shape the Python engine AOT-compiles
+    # (serving.resolve_buckets is the one bucket-set definition), so the
+    # C++ runner can serve the engine's bucket set. Each bucket dir is
+    # self-contained (bin + mlir + compile options); meta.json (below)
+    # records the set.
+    serve_buckets = []
+    serve_rel = {}
+    if cfg.export_serve:
+        from .serving import resolve_buckets
+        serve_buckets = list(resolve_buckets(cfg))
+        for b in serve_buckets:
+            bdir = os.path.join(out_dir, "serving", "b%d" % b)
+            os.makedirs(bdir, exist_ok=True)
+            bspec = jax.ShapeDtypeStruct((b, imsize, imsize, 3), in_dtype)
+            bexp = jax_export.export(jax.jit(fn))(bspec)
+            atomic_write_bytes(os.path.join(bdir, "exported_predict.bin"),
+                               bexp.serialize())
+            atomic_write_bytes(
+                os.path.join(bdir, "exported_predict.stablehlo.mlir"),
+                bexp.mlir_module().encode())
+            # each bucket dir is a COMPLETE runner artifact: the C++
+            # runner reads meta.json (input_shape) + compile_options.pb
+            # from whatever dir it is pointed at (runner.cc:248-250), so
+            # `pjrt_runner <plugin> <out_dir>/serving/b<N>` serves bucket N
+            save_json(os.path.join(bdir, "meta.json"), {
+                "input_shape": [b, imsize, imsize, 3],
+                "input_dtype": "uint8" if cfg.export_raw_input
+                               else "float32",
+                "num_boxes": cfg.num_stack * cfg.topk,
+                "imsize": imsize, "num_cls": cfg.num_cls,
+                "raw_input": bool(cfg.export_raw_input),
+                "infer_dtype": cfg.infer_dtype,
+                "serve_bucket": b,
+            }, indent=2)
+            serve_rel["b%d" % b] = os.path.relpath(bdir, out_dir)
+        try:
+            from jax._src.lib import xla_client as xc
+            for b in serve_buckets:
+                atomic_write_bytes(
+                    os.path.join(out_dir, serve_rel["b%d" % b],
+                                 "compile_options.pb"),
+                    xc.CompileOptions().SerializeAsString())
+        except Exception as e:  # pragma: no cover - jaxlib internals move
+            print("warning: could not write bucket compile_options.pb:", e)
+
     save_json(os.path.join(out_dir, "meta.json"), {
         "input_shape": [batch_size, imsize, imsize, 3],
         "input_dtype": "uint8" if cfg.export_raw_input else "float32",
@@ -172,6 +218,11 @@ def export_predict(cfg: Config, out_dir: Optional[str] = None,
         "infer_dtype": cfg.infer_dtype,
         "quant_scales_sha256": scales_sha,
         "quant_scales_path": scales_rel,
+        # the serve bucket set (--export-serve): per-bucket artifact dirs,
+        # each holding the same program at that batch shape — a C++ server
+        # compiles them all at startup exactly like the Python engine
+        "serve_buckets": serve_buckets,
+        "serve_artifacts": serve_rel,
     }, indent=2)
     return bin_path, mlir_path
 

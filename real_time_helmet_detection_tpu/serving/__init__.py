@@ -1,0 +1,17 @@
+"""Continuous-batching serving engine (ISSUE 8).
+
+The reference's deployment story is a C++ app running the traced model one
+frame at a time (ref README.md:76); this package is the system around the
+jitted predict program that the reference never built: dynamic
+micro-batching into fixed-shape buckets, multiple in-flight batches, and
+admission control. See `engine.py` and docs/ARCHITECTURE.md "Serving
+engine".
+"""
+
+from .engine import (DEFAULT_BUCKETS, EngineClosedError, ServeFuture,
+                     ServingEngine, SheddedError, resolve_buckets)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "EngineClosedError", "ServeFuture", "ServingEngine",
+    "SheddedError", "resolve_buckets",
+]

@@ -194,6 +194,26 @@ AST_FIXTURES = {
 }
 
 
+SERVING_FIXTURES = {
+    # rules scoped to the serving package render at a serving/ path
+    "device-get-in-serving-loop": (
+        # a per-request fetch inside the batch loop — the sync the engine
+        # exists to amortize
+        "import jax\n"
+        "def fetch_all(requests, compiled, variables):\n"
+        "    out = []\n"
+        "    for r in requests:\n"
+        "        out.append(jax.device_get(compiled(variables, r)))\n"
+        "    return out\n",
+        # the engine pattern: dispatch per request, ONE batched fetch
+        "import jax\n"
+        "def fetch_all(requests, compiled, variables):\n"
+        "    pending = [compiled(variables, r) for r in requests]\n"
+        "    return jax.device_get(pending)\n",
+    ),
+}
+
+
 def _selfcheck_ast(check) -> None:
     for short, (bad, good) in AST_FIXTURES.items():
         rule = "ast/" + short
@@ -205,6 +225,20 @@ def _selfcheck_ast(check) -> None:
               any(f.rule == rule for f in bad_f))
         check("%s silent on good fixture" % rule,
               not any(f.rule == rule for f in good_f))
+    for short, (bad, good) in SERVING_FIXTURES.items():
+        rule = "ast/" + short
+        spath = ast_rules.SERVING_PREFIX + "fixture_%s.py"
+        bad_f = ast_rules.lint_source(bad, spath % "bad")
+        good_f = ast_rules.lint_source(good, spath % "good")
+        check("%s fires on bad fixture" % rule,
+              any(f.rule == rule for f in bad_f))
+        check("%s silent on good fixture" % rule,
+              not any(f.rule == rule for f in good_f))
+        # out-of-scope twin: the same bad source outside serving/ must not
+        # fire this rule (the generic device-get-in-loop covers it there)
+        check("%s scoped to serving/" % rule,
+              not any(f.rule == rule for f in ast_rules.lint_source(
+                  bad, "scripts/fixture_scope.py")))
     # suppression marker: the bad fixture plus an inline off= goes silent
     bad = AST_FIXTURES["raw-artifact-write"][0].replace(
         "'w') as f:", "'w') as f:  # graftlint: off=raw-artifact-write")
@@ -306,6 +340,16 @@ def _selfcheck_trace(check) -> None:
     # epilogue in every conv tail) must audit clean like the surfaces
     # they replace — donation/f64/dynamic-shape included (full audit_entry
     # incl. lowering)
+    # the serve bucket set (ISSUE 8): every bucket the engine AOT-compiles
+    # must audit clean — the bucket programs ARE the production serving
+    # surface (dynamic-shape/f64/host-callback rules across the set)
+    for b in ta.SERVE_BUCKETS_AUDIT[:2]:
+        predict_s, variables_s, images_s = ta._tiny_serve_parts(b)
+        sf = ta.audit_entry(lambda v, im: predict_s(v, im),
+                            (variables_s, images_s),
+                            "serve_predict[b=%d]" % b, lower=b == 1)
+        check("serve bucket b=%d audits clean" % b, not sf)
+
     train_bf16, targs_bf16 = ta._tiny_train_parts("none", "bf16-compute")
     pf = ta.audit_entry(train_bf16, targs_bf16,
                         "train_step_scanned[param=bf16-compute]",

@@ -118,6 +118,63 @@ def summarize_spans(paths: List[str]) -> Dict:
             "events": events, "context": ctx, "recompiles": recompiles}
 
 
+def summarize_serving(paths: List[str]) -> Optional[Dict]:
+    """The serving-engine section (ISSUE 8): p50/p99 joined from the
+    engine's span taxonomy (serve:e2e per request, serve:queue-wait,
+    the serve:batch-form/h2d/compute/d2h stages, serve:shed events).
+    Returns None when the round recorded no serving activity."""
+    e2e: List[float] = []
+    qwait: List[float] = []
+    stages: Dict[str, List[float]] = {}
+    shed: Dict[str, int] = {}
+    fills: List[int] = []
+    batches = 0
+    for path in paths:
+        for rec in read_spans(path):
+            name = rec.get("name", "")
+            if not name.startswith("serve:"):
+                continue
+            if rec.get("kind") == "event" and name == "serve:shed":
+                reason = (rec.get("meta") or {}).get("reason", "?")
+                shed[reason] = shed.get(reason, 0) + 1
+                continue
+            dur = rec.get("dur_s")
+            if not isinstance(dur, (int, float)):
+                continue
+            if name == "serve:e2e":
+                e2e.append(float(dur))
+            elif name == "serve:queue-wait":
+                qwait.append(float(dur))
+            else:
+                stages.setdefault(name[len("serve:"):], []).append(
+                    float(dur))
+            if name == "serve:batch-form":
+                batches += 1
+                n = (rec.get("meta") or {}).get("n")
+                if isinstance(n, int):
+                    fills.append(n)
+    if not (e2e or qwait or stages or shed):
+        return None
+
+    def digest(vals: List[float]) -> Dict:
+        s = sorted(vals)
+        return {"count": len(s),
+                "p50_ms": round(_pctl(s, 0.50) * 1e3, 3),
+                "p99_ms": round(_pctl(s, 0.99) * 1e3, 3),
+                "max_ms": round((s[-1] if s else float("nan")) * 1e3, 3)}
+
+    out: Dict = {"requests": len(e2e), "batches": batches,
+                 "shed": shed, "shed_total": sum(shed.values())}
+    if e2e:
+        out["e2e"] = digest(e2e)
+    if qwait:
+        out["queue_wait"] = digest(qwait)
+    if fills:
+        out["mean_batch_fill"] = round(sum(fills) / len(fills), 2)
+    out["stages"] = {name: digest(v) for name, v in sorted(stages.items())}
+    return out
+
+
 def summarize_queue(queue_dir: Optional[str]) -> Optional[Dict]:
     """Read-only tolerant replay of the job journal: per-job final state,
     attempts, salvage evidence, queued->terminal wall seconds."""
@@ -227,6 +284,7 @@ def build_report(round_name: str, span_paths: List[str],
     return {
         "schema": SCHEMA, "tool": "obs_report", "round": round_name,
         "spans": summarize_spans(span_paths),
+        "serving": summarize_serving(span_paths),
         "queue": summarize_queue(queue_dir),
         "bench": summarize_bench(bench_paths),
         "loss": summarize_loss_log(loss_paths),
@@ -265,6 +323,34 @@ def render_markdown(rep: Dict) -> str:
     lines += ["", "Recompiles: %d compile span(s), %.1f s total" % (
         sp["recompiles"]["compile_spans"],
         sp["recompiles"]["compile_total_s"]), ""]
+    srv = rep.get("serving")
+    lines += ["## Serving", ""]
+    if srv:
+        e2e = srv.get("e2e", {})
+        lines += ["%d request(s) over %d batch(es)%s; shed: %s"
+                  % (srv["requests"], srv["batches"],
+                     (", mean fill %.2f" % srv["mean_batch_fill"]
+                      if "mean_batch_fill" in srv else ""),
+                     (", ".join("%s ×%d" % (k, v)
+                                for k, v in sorted(srv["shed"].items()))
+                      or "none")), ""]
+        if e2e:
+            lines += ["e2e latency: p50 %.3f ms, p99 %.3f ms (n=%d)"
+                      % (e2e["p50_ms"], e2e["p99_ms"], e2e["count"]), ""]
+        if srv["stages"] or srv.get("queue_wait"):
+            lines += ["| stage | count | p50 ms | p99 ms | max ms |",
+                      "|---|---|---|---|---|"]
+            rows = dict(srv["stages"])
+            if srv.get("queue_wait"):
+                rows["queue-wait"] = srv["queue_wait"]
+            for name in sorted(rows):
+                s = rows[name]
+                lines.append("| %s | %d | %.3f | %.3f | %.3f |"
+                             % (name, s["count"], s["p50_ms"],
+                                s["p99_ms"], s["max_ms"]))
+    else:
+        lines.append("_no serving activity recorded_")
+    lines += [""]
     q = rep["queue"]
     lines += ["## Queue", ""]
     if q:
@@ -356,6 +442,17 @@ def selfcheck() -> int:
             pass
         tracer.event("heartbeat", label="flush 0")
         tracer.context(phase="selfcheck")
+        # serving-engine taxonomy (ISSUE 8): two 2-request batches with
+        # stage spans, one queue-full shed — the serving section's joins
+        for i in range(4):
+            tracer.record("serve:queue-wait", 0.002 * (i + 1), b=2)
+            tracer.record("serve:e2e", 0.010 * (i + 1), b=2)
+        for i in range(2):
+            tracer.record("serve:batch-form", 0.001, n=2)
+            tracer.record("serve:h2d", 0.001, b=2)
+            tracer.record("serve:compute", 0.0005, b=2)
+            tracer.record("serve:d2h", 0.008, b=2, n=2)
+        tracer.event("serve:shed", reason="queue-full")
         tracer.close()
         with open(span_path, "a") as f:  # graftlint: off=raw-artifact-write
             f.write('{"kind": "span", "torn')  # kill -9 mid-append twin
@@ -410,13 +507,26 @@ def selfcheck() -> int:
         check("schema tagged", rep["schema"] == SCHEMA)
         sp = rep["spans"]
         check("torn span tail dropped, all real records read",
-              sp["records"] == 8)  # meta + 4 steps + ckpt + hb + ctx
+              sp["records"] == 25)  # meta + 4 steps + ckpt + hb + ctx
+        # + 16 serve spans + shed event
         check("step span stats", sp["by_name"].get("step", {}).get(
             "count") == 4 and abs(sp["by_name"]["step"]["total_s"]
                                   - 0.1) < 1e-6)
         check("heartbeat event counted",
               sp["events"].get("heartbeat") == 1)
         check("context sampled", sp["context"]["samples"] == 1)
+        srv = rep["serving"]
+        check("serving section joined", srv is not None
+              and srv["requests"] == 4 and srv["batches"] == 2
+              and srv["shed"] == {"queue-full": 1})
+        # nearest-rank percentiles over [10, 20, 30, 40] ms: p50 idx
+        # round(0.5*3)=2 -> 30, p99 idx 3 -> 40
+        check("serving p50/p99 computed",
+              srv["e2e"]["p50_ms"] == 30.0 and srv["e2e"]["p99_ms"] == 40.0
+              and srv["queue_wait"]["count"] == 4)
+        check("serving stage digests + fill",
+              set(srv["stages"]) == {"batch-form", "h2d", "compute", "d2h"}
+              and srv["mean_batch_fill"] == 2.0)
         q = rep["queue"]
         check("queue states joined", q is not None
               and q["jobs"]["bench"]["state"] == "done"
@@ -437,6 +547,8 @@ def selfcheck() -> int:
               and os.path.exists(os.path.join(tmp, "out", "report.md")))
         md = open(os.path.join(tmp, "out", "report.md")).read()
         check("markdown carries queue table", "| bench | done |" in md)
+        check("markdown carries serving section",
+              "## Serving" in md and "e2e latency: p50 30.000 ms" in md)
 
     ok = not failures
     print(json.dumps({"tool": "obs_report", "selfcheck": True, "ok": ok,

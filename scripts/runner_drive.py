@@ -142,9 +142,78 @@ def cpu_reference_dets(export_dir: str, image) -> list:
     return dets
 
 
+def serve_smoke(export_dir: str, imsize: int = 64,
+                buckets=(1, 2, 4)) -> dict:
+    """Serve-mode smoke (ISSUE 8): export the per-bucket StableHLO set
+    (`--export-serve`) at CPU-friendly shapes, then prove every bucket
+    artifact round-trips — deserialize, execute a zeros batch at the
+    bucket's shape, check the fixed-shape Detections contract. This is
+    the C++ server's artifact contract checked end-to-end without a chip
+    (the real runner consumes the same .mlir files; artifacts/r02/README
+    §5 has the chip invocation)."""
+    import jax
+    import numpy as np
+
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.export import (export_predict,
+                                                       load_exported)
+
+    cfg = Config(num_stack=1, hourglass_inch=16, num_cls=2, imsize=imsize,
+                 topk=16, conf_th=0.0, nms="nms", nms_th=0.5,
+                 save_path=export_dir, export_raw_input=True,
+                 export_serve=True, serve_buckets=list(buckets))
+    from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
+    with maybe_tracer().span("serve-smoke-export", dir=export_dir) as sp:
+        export_predict(cfg, export_dir)
+    rec: dict = {"export_s": round(sp.dur_s, 1), "buckets": {}}
+    with open(os.path.join(export_dir, "meta.json")) as f:
+        meta = json.load(f)
+    rec["meta_serve_buckets"] = meta.get("serve_buckets")
+    n_boxes = int(meta["num_boxes"])
+    for b in buckets:
+        bdir = os.path.join(export_dir, "serving", "b%d" % b)
+        exported = load_exported(
+            os.path.join(bdir, "exported_predict.bin"))
+        boxes, classes, scores, valid = [
+            np.asarray(a) for a in exported.call(
+                np.zeros((b, imsize, imsize, 3), np.uint8))]
+        # a complete C++ runner artifact dir: program + meta +
+        # compile options (runner.cc reads all three from its dir arg)
+        bmeta = json.load(open(os.path.join(bdir, "meta.json")))
+        ok = (boxes.shape == (b, n_boxes, 4)
+              and classes.shape == (b, n_boxes)
+              and scores.shape == (b, n_boxes)
+              and valid.shape == (b, n_boxes)
+              and bmeta["input_shape"][0] == b
+              and bmeta["serve_bucket"] == b
+              and os.path.exists(os.path.join(
+                  bdir, "exported_predict.stablehlo.mlir"))
+              and os.path.exists(os.path.join(bdir,
+                                              "compile_options.pb")))
+        rec["buckets"]["b%d" % b] = {
+            "ok": bool(ok), "mlir": True,
+            "valid_count": int(valid.sum())}
+        HB.beat("serve smoke b=%d" % b)
+    rec["ok"] = all(v["ok"] for v in rec["buckets"].values()) \
+        and list(meta.get("serve_buckets", [])) == sorted(buckets)
+    return rec
+
+
 def main() -> None:
     import jax
     jax.config.update("jax_platforms", "cpu")  # C++ runner owns the chip
+
+    if "--serve-smoke" in sys.argv:
+        # CPU-only bucket-set artifact proof; no chip, no runner binary
+        out = os.path.join(REPO, "artifacts", ROUND, "serving",
+                           "runner_serve_smoke.json")
+        rec = serve_smoke(os.path.join(WORK, "export_serve"))
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        save_json(out, rec, indent=1)
+        print(json.dumps(rec))
+        if not rec["ok"]:
+            raise SystemExit("serve smoke failed: %s" % rec)
+        return
 
     from real_time_helmet_detection_tpu.config import Config
     from real_time_helmet_detection_tpu.export import export_predict

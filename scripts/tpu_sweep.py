@@ -17,7 +17,8 @@ artifacts/<round>/sweep.json (round from $GRAFT_ROUND, default
 bench.GRAFT_ROUND_DEFAULT — one constant for every round-scoped script) after
 every single config — a killed run loses at most the in-flight config —
 and `--only <section>[,<section>]` reruns just the missing sections
-(inference, train, stack2, remat, stack4_768, step_grid).
+(inference, train, stack2, remat, stack4_768, step_grid, int8,
+serve).
 
 `step_grid` (ISSUE 2, grown by ISSUE 7) is the (batch x remat x
 loss-kernel x param-policy x epilogue) matrix that picks the
@@ -80,7 +81,7 @@ SECTION_KEYS = {"inference": "inference_batch_sweep",
                 "train": "train_batch_sweep",
                 "stack2": "num_stack2", "remat": "remat",
                 "stack4_768": "stack4_768", "step_grid": "step_grid",
-                "int8": "int8_inference"}
+                "int8": "int8_inference", "serve": "serve_buckets"}
 
 
 def merge_prior(results: dict, prior: dict, only: set) -> dict:
@@ -165,7 +166,7 @@ def main() -> None:
         "dispatch_ms": round(overhead * 1e3, 3),
         "inference_batch_sweep": [], "train_batch_sweep": [],
         "num_stack2": {}, "remat": [], "stack4_768": [], "step_grid": [],
-        "int8_inference": [],
+        "int8_inference": [], "serve_buckets": [],
     }
     def read_prior(path):
         """Prior results at `path`, or None if absent/unreadable — a kill
@@ -545,6 +546,60 @@ def main() -> None:
                 results["int8_inference"].append(
                     {"batch": batch, "error": str(e).splitlines()[-1][:200]})
                 log("int8 b=%d FAILED: %r" % (batch, e))
+            flush()
+
+    # --- 8. serve bucket latency table (ISSUE 8) --------------------------
+    # The per-bucket batch latency of the SERVE-WIRE program (raw uint8 in,
+    # normalize on-device — the engine's ingress contract), one cell per
+    # bucket of the default serve set. This is the table that sizes the
+    # serving knobs: deadline >= queue_wait + (depth+2) x the largest
+    # bucket's ms_per_batch (docs/ARCHITECTURE.md "Serving engine").
+    # Per-cell flush + prior-cell resume, the int8 section's discipline.
+    if want("serve"):
+        def bench_serve(bucket, n):
+            cfg = Config(num_stack=1, hourglass_inch=128, num_cls=2,
+                         topk=100, conf_th=0.0, nms_th=0.5, imsize=imsize)
+            model = build_model(cfg, dtype=jnp.bfloat16 if on_tpu
+                                else None)
+            params, batch_stats = init_variables(model, jax.random.key(0),
+                                                 imsize)
+            variables = {"params": params, "batch_stats": batch_stats}
+            predict = make_predict_fn(model, cfg, normalize="imagenet")
+            images = jnp.asarray(rng.integers(
+                0, 256, (bucket, imsize, imsize, 3)).astype(np.uint8))
+            with tracer.span("compile", section="serve",
+                             bucket=bucket) as sp:
+                compiled = predict_chain(predict, n).lower(
+                    variables, images).compile()
+            images, s = compiled(variables, images)  # warmup (donates)
+            np.asarray(s)
+            dt = chain_timed_fetch(compiled, variables, images, overhead)
+            return {"bucket": bucket,
+                    "img_per_sec": round(bucket * n / dt, 1),
+                    "ms_per_batch": round(dt / n * 1e3, 3),
+                    "compile_s": round(sp.dur_s, 1)}
+
+        prior_cells = [r for r in (prior or {}).get("serve_buckets", [])
+                       if "ms_per_batch" in r]
+        for r in prior_cells:
+            if r not in results["serve_buckets"]:
+                results["serve_buckets"].append(r)
+        done = {r.get("bucket") for r in results["serve_buckets"]
+                if "ms_per_batch" in r}
+        for bucket in ([1, 2, 4, 8, 16] if on_tpu else [1, 2]):
+            if bucket in done:
+                log("serve b=%d already measured; skipping" % bucket)
+                continue
+            n = max(32, min(512, 4096 // bucket)) if on_tpu else 2
+            try:
+                rec = bench_serve(bucket, n)
+                results["serve_buckets"].append(rec)
+                log("serve b=%d: %s" % (bucket, rec))
+            except Exception as e:  # noqa: BLE001
+                results["serve_buckets"].append(
+                    {"bucket": bucket,
+                     "error": str(e).splitlines()[-1][:200]})
+                log("serve b=%d FAILED: %r" % (bucket, e))
             flush()
 
     flush()

@@ -193,7 +193,7 @@ def test_sweep_section_keys_cover_all_result_lists():
     sweep = _load_sweep()
     assert set(sweep.SECTION_KEYS.values()) == {
         "inference_batch_sweep", "train_batch_sweep", "num_stack2", "remat",
-        "stack4_768", "step_grid", "int8_inference"}
+        "stack4_768", "step_grid", "int8_inference", "serve_buckets"}
 
 
 def test_find_last_tpu_result_carries_int8_fields(tmp_path):
@@ -401,3 +401,28 @@ def test_sweep_step_grid_cell_identity_fields():
            rec_old.get("loss_kernel"), rec_old.get("param_policy", "fp32"),
            rec_old.get("epilogue", "xla"))
     assert key == (16, "none", "xla", "fp32", "xla")
+
+
+def test_find_last_tpu_result_carries_serve_fields(tmp_path):
+    """ISSUE 8 satellite: the --serve closed-loop headline
+    (serve_p50_ms/serve_p99_ms/serve_goodput) rides find_last_tpu_result;
+    old lines without the keys are unaffected."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r10", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1250.0,
+        "mfu_train": 0.61, "serve_p50_ms": 18.5, "serve_p99_ms": 41.2,
+        "serve_goodput": 1180.0})
+    got = bench.find_last_tpu_result(root)
+    assert got["serve_p50_ms"] == 18.5
+    assert got["serve_p99_ms"] == 41.2
+    assert got["serve_goodput"] == 1180.0
+    # pre-existing consumer contract unchanged
+    assert got["value"] == 1250.0 and got["mfu_train"] == 0.61
+
+
+def test_find_last_tpu_result_old_lines_lack_serve_keys(tmp_path):
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r09", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1100.0})
+    got = bench.find_last_tpu_result(root)
+    assert "serve_p50_ms" not in got and "serve_goodput" not in got

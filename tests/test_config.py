@@ -173,3 +173,29 @@ def test_preset_validation_and_noop():
         Config(preset="fastest")
     cfg = Config()
     assert apply_preset(cfg) is cfg  # unset preset touches nothing
+
+
+def test_serve_flags_parse_and_validate():
+    """ISSUE 8: the serving-engine knobs exist as generated CLI flags and
+    validate loudly."""
+    import pytest
+
+    cfg = parse_args(["--serve-buckets", "1", "4", "8",
+                      "--serve-max-wait-ms", "2.5", "--serve-depth", "3",
+                      "--serve-queue", "64", "--export-serve"])
+    assert cfg.serve_buckets == [1, 4, 8]
+    assert cfg.serve_max_wait_ms == 2.5
+    assert cfg.serve_depth == 3
+    assert cfg.serve_queue == 64
+    assert cfg.export_serve is True
+    d = parse_args([])
+    assert d.serve_buckets == [1, 2, 4, 8, 16]  # engine/export/audit set
+    assert d.export_serve is False
+    with pytest.raises(ValueError, match="serve-buckets"):
+        Config(serve_buckets=[0])
+    with pytest.raises(ValueError, match="serve-max-wait-ms"):
+        Config(serve_max_wait_ms=-1.0)
+    with pytest.raises(ValueError, match="serve-depth"):
+        Config(serve_depth=0)
+    with pytest.raises(ValueError, match="serve-queue"):
+        Config(serve_queue=0)

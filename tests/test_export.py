@@ -97,3 +97,37 @@ def test_export_raw_input_bakes_normalization(tmp_path):
     b2, c2, s2, v2 = f_norm.call(jnp.asarray(normed))
     np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), atol=1e-5)
     np.testing.assert_allclose(np.asarray(b1), np.asarray(b2), atol=1e-3)
+
+
+def test_export_serve_emits_per_bucket_artifacts(tmp_path):
+    """--export-serve (ISSUE 8): one self-contained StableHLO artifact per
+    serve bucket, the bucket set recorded in meta.json, and every bucket
+    program row-identical to the primary artifact on the same image."""
+    out = str(tmp_path)
+    cfg = tiny_cfg(save_path=out, export_serve=True, serve_buckets=[1, 2])
+    export_predict(cfg, out_dir=out)
+    meta = json.load(open(os.path.join(out, "meta.json")))
+    assert meta["serve_buckets"] == [1, 2]
+    assert set(meta["serve_artifacts"]) == {"b1", "b2"}
+    primary = load_exported(os.path.join(out, "exported_predict.bin"))
+    img = np.random.default_rng(0).standard_normal(
+        (1, 64, 64, 3)).astype(np.float32)
+    ref = [np.asarray(a) for a in primary.call(img)]
+    for b in (1, 2):
+        bdir = os.path.join(out, "serving", "b%d" % b)
+        assert os.path.getsize(
+            os.path.join(bdir, "exported_predict.stablehlo.mlir")) > 1000
+        exported = load_exported(
+            os.path.join(bdir, "exported_predict.bin"))
+        batch = np.concatenate([img] * b)
+        got = [np.asarray(a) for a in exported.call(batch)]
+        for r, g in zip(ref, got):
+            for row in range(b):  # every row == the b1 one-shot result
+                assert np.array_equal(g[row], r[0])
+
+
+def test_export_without_serve_flag_stays_lean(exported):
+    _, out, _, _ = exported
+    meta = json.load(open(os.path.join(out, "meta.json")))
+    assert meta["serve_buckets"] == []
+    assert not os.path.exists(os.path.join(out, "serving"))

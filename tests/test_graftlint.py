@@ -277,3 +277,57 @@ def test_cli_selfcheck_subprocess():
     rec = json.loads(lines[0])
     assert rec["ok"] is True and rec["selfcheck"] is True
     assert rec["failures"] == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: serving-scoped rule + per-bucket trace entries
+
+
+def test_serving_fetch_rule_fires_and_is_scoped():
+    """ast/device-get-in-serving-loop: a per-request fetch in a serving
+    loop fires; the batched-fetch twin is silent; the same bad source
+    OUTSIDE serving/ is the generic rule's business, not this one's."""
+    bad = ("import jax\n"
+           "def fetch_all(requests, compiled, v):\n"
+           "    out = []\n"
+           "    for r in requests:\n"
+           "        out.append(jax.device_get(compiled(v, r)))\n"
+           "    return out\n")
+    good = ("import jax\n"
+            "def fetch_all(requests, compiled, v):\n"
+            "    pending = [compiled(v, r) for r in requests]\n"
+            "    return jax.device_get(pending)\n")
+    spath = ast_rules.SERVING_PREFIX + "x.py"
+    assert "ast/device-get-in-serving-loop" in rules_of(
+        ast_rules.lint_source(bad, spath))
+    assert "ast/device-get-in-serving-loop" not in rules_of(
+        ast_rules.lint_source(good, spath))
+    assert "ast/device-get-in-serving-loop" not in rules_of(
+        ast_rules.lint_source(bad, "scripts/x.py"))
+
+
+def test_serving_fetch_allowlist_names_the_engine_fetch_loop():
+    """The allowlisted qualname must be the engine's real fetch loop —
+    if the method moves/renames, the allowlist (and this pin) must move
+    with it, not silently allowlist nothing."""
+    import ast as pyast
+    path = os.path.join(REPO, "real_time_helmet_detection_tpu", "serving",
+                        "engine.py")
+    tree = pyast.parse(open(path).read())
+    quals = {"%s.%s" % (c.name, f.name)
+             for c in pyast.walk(tree) if isinstance(c, pyast.ClassDef)
+             for f in c.body if isinstance(f, pyast.FunctionDef)}
+    for entry in ast_rules.SERVING_FETCH_ALLOW:
+        assert entry.split("::")[1] in quals
+
+
+def test_serve_bucket_entries_audit_clean():
+    """Every serve bucket's program (the engine's per-bucket AOT surface)
+    passes the trace rules — the bucket SET is the production surface,
+    not just the eval batch shape."""
+    for b in trace_audit.SERVE_BUCKETS_AUDIT[:2]:
+        predict, variables, images = trace_audit._tiny_serve_parts(b)
+        findings = trace_audit.audit_entry(
+            lambda v, im: predict(v, im), (variables, images),
+            "serve_predict[b=%d]" % b, lower=False)
+        assert not findings, [f.message for f in findings]

@@ -55,3 +55,14 @@ def test_parse_runner_tolerates_failure_output():
     rec = rd.parse_runner("dlopen failed: no such file\n")
     assert rec["detections"] == []
     assert "img_per_sec" not in rec
+
+
+def test_serve_smoke_round_trips_every_bucket(tmp_path):
+    """ISSUE 8: runner_drive's serve-mode smoke — per-bucket export,
+    CPU deserialize, zeros-batch execution, fixed-shape contract."""
+    rec = _load().serve_smoke(str(tmp_path / "exp"), imsize=64,
+                              buckets=(1, 2))
+    assert rec["ok"] is True
+    assert set(rec["buckets"]) == {"b1", "b2"}
+    assert all(v["ok"] for v in rec["buckets"].values())
+    assert rec["meta_serve_buckets"] == [1, 2]

@@ -1,0 +1,113 @@
+"""serve_bench tests (ISSUE 8 satellite): the load-generator helpers'
+accounting (schedule, goodput, deadline bookkeeping) and the --selfcheck
+contract as a real subprocess — mirroring tpu_queue/graftlint/obs_report
+selfcheck wiring in the smoke tier.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_serve_bench():
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(REPO, "scripts", "serve_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_arrival_schedule_seeded_and_bounded():
+    sb = _load_serve_bench()
+    a = sb.arrival_schedule(100.0, 2.0, seed=5)
+    b = sb.arrival_schedule(100.0, 2.0, seed=5)
+    assert a == b  # same trace drives engine AND serial baseline
+    assert all(0 < t < 2.0 for t in a)
+    assert a == sorted(a)
+    # Poisson at 100 rps over 2 s: ~200 arrivals, loose 3-sigma bounds
+    assert 140 < len(a) < 260
+    assert sb.arrival_schedule(100.0, 2.0, seed=6) != a
+
+
+def test_percentile_and_latency_digest():
+    sb = _load_serve_bench()
+    assert sb._pctl([1.0, 2.0, 3.0, 4.0], 0.0) == 1.0
+    assert sb._pctl([1.0, 2.0, 3.0, 4.0], 1.0) == 4.0
+    d = sb._lat_ms([0.010, 0.020, 0.030, 0.040])
+    assert d["p50_ms"] == 30.0 and d["p99_ms"] == 40.0
+    assert sb._lat_ms([]) == {"p50_ms": None, "p99_ms": None,
+                              "mean_ms": None}
+
+
+def test_serial_loop_goodput_collapses_past_saturation():
+    """The acceptance mechanism in miniature: a FIFO b1 server whose
+    service time is 10 ms, offered 2x its capacity with a 50 ms deadline —
+    queueing delay grows linearly and goodput collapses to the early
+    prefix, while a capacity-matched offered load stays on time."""
+    sb = _load_serve_bench()
+
+    class _FakeDets:
+        scores = np.zeros((1,))
+
+    class _FakeB1:
+        def __call__(self, variables, img):
+            import time
+            time.sleep(0.010)
+            return _FakeDets()
+
+    pool = [np.zeros((4, 4, 3), np.uint8)]
+    # past saturation: 200 rps offered vs ~100 rps capacity
+    sched = sb.arrival_schedule(200.0, 1.0, seed=1)
+    over = sb.serial_loop(_FakeB1(), None, pool, sched, 1.0,
+                          deadline_s=0.05, offered_rps=200.0)
+    assert over["served"] < len(sched)  # fell behind
+    assert over["goodput_rps"] < 30.0  # collapse: only the early prefix
+    # sub-saturation: 50 rps offered, everything on time
+    sched2 = sb.arrival_schedule(50.0, 1.0, seed=2)
+    under = sb.serial_loop(_FakeB1(), None, pool, sched2, 1.0,
+                           deadline_s=0.05, offered_rps=50.0)
+    assert under["ontime"] == under["served"] > 0
+    assert under["goodput_rps"] > over["goodput_rps"]
+
+
+def test_selfcheck_subprocess():
+    """`serve_bench.py --selfcheck` — the CPU proof of the engine contract
+    (bit-identity, sheds, zero recompiles) — passes as a real subprocess
+    and prints ONE JSON line last."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_bench.py"),
+         "--selfcheck"],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    rec = json.loads(lines[-1])
+    assert rec["ok"] is True and rec["selfcheck"] is True
+    assert rec["tool"] == "serve_bench" and not rec["failures"]
+
+
+def test_committed_cpu_artifact_meets_the_gate():
+    """The acceptance artifact (artifacts/r10/serving/serve_bench.json,
+    schema serve-bench-v1) must exist, carry the offered-load curve, and
+    record engine goodput >= 3x the serial b1 loop past saturation."""
+    path = os.path.join(REPO, "artifacts", "r10", "serving",
+                        "serve_bench.json")
+    if not os.path.exists(path):
+        pytest.skip("r10 serving artifact not generated yet")
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["schema"] == "serve-bench-v1"
+    assert rec["gate_3x"] is True
+    assert rec["goodput_vs_serial_at_overload"] >= 3.0
+    loads = [row["load_multiplier"] for row in rec["curve"]]
+    assert any(m > 1.0 for m in loads)  # past saturation measured
+    for row in rec["curve"]:
+        if row["completed"]:
+            assert row["p50_ms"] <= row["p99_ms"]

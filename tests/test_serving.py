@@ -1,0 +1,220 @@
+"""Serving-engine tests (ISSUE 8): the bucketed AOT continuous-batching
+engine must be bit-identical to one-shot predict for ANY request stream,
+never recompile after construction, and shed deterministically under
+admission control. All CPU; the tiny predict fixture is module-scoped so
+the per-bucket AOT compiles happen once.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+from real_time_helmet_detection_tpu.config import Config  # noqa: E402
+from real_time_helmet_detection_tpu.models import build_model  # noqa: E402
+from real_time_helmet_detection_tpu.predict import \
+    make_predict_fn  # noqa: E402
+from real_time_helmet_detection_tpu.serving import (  # noqa: E402
+    DEFAULT_BUCKETS, EngineClosedError, ServingEngine, SheddedError,
+    resolve_buckets)
+from real_time_helmet_detection_tpu.train import init_variables  # noqa: E402
+
+IMSIZE = 64
+BUCKETS = (1, 2, 4)
+
+
+@pytest.fixture(scope="module")
+def parts():
+    cfg = Config(num_stack=1, hourglass_inch=8, num_cls=2, topk=16,
+                 conf_th=0.0, nms_th=0.5, imsize=IMSIZE)
+    model = build_model(cfg)
+    params, batch_stats = init_variables(model, jax.random.key(0), IMSIZE)
+    variables = {"params": params, "batch_stats": batch_stats}
+    predict = make_predict_fn(model, cfg, normalize="imagenet")
+    rng = np.random.default_rng(3)
+    pool = [rng.integers(0, 256, (IMSIZE, IMSIZE, 3), dtype=np.uint8)
+            for _ in range(10)]
+    # one-shot oracle rows at batch 1: dispatch all, one batched fetch
+    pending = [predict(variables, img[None]) for img in pool]
+    oracle = [type(d)(*(np.asarray(leaf[0]) for leaf in d))
+              for d in jax.device_get(pending)]
+    return cfg, predict, variables, pool, oracle
+
+
+@pytest.fixture(scope="module")
+def engine(parts):
+    _, predict, variables, _, _ = parts
+    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
+                        buckets=BUCKETS, max_wait_ms=2.0, depth=2,
+                        queue_capacity=64)
+    yield eng
+    eng.close()
+
+
+def _rows_equal(a, b) -> bool:
+    return all(np.array_equal(getattr(a, n), getattr(b, n))
+               for n in ("boxes", "classes", "scores", "valid"))
+
+
+def test_any_stream_bit_identical_to_one_shot(parts, engine):
+    """The acceptance property: ANY request stream — sizes, arrival
+    order, interleaving, pacing — yields detections bit-identical to the
+    one-shot predict of each image (property-style over seeded random
+    streams; per-image independence means bucket choice and co-batched
+    neighbors must not change a single bit)."""
+    _, _, _, pool, oracle = parts
+    rng = np.random.default_rng(17)
+    for stream in range(3):
+        futs = []
+        for _ in range(6):
+            k = int(rng.integers(1, 7))  # burst size spanning buckets
+            for i in rng.integers(0, len(pool), k):
+                futs.append((int(i), engine.submit(pool[int(i)])))
+            if rng.random() < 0.5:
+                time.sleep(float(rng.uniform(0, 0.004)))  # pacing jitter
+        for i, fut in futs:
+            assert _rows_equal(fut.result(timeout=60), oracle[i]), \
+                "stream %d: request for image %d diverged" % (stream, i)
+
+
+def test_partial_batch_takes_smallest_bucket(parts):
+    _, predict, variables, pool, oracle = parts
+    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
+                        buckets=BUCKETS, max_wait_ms=50.0, depth=1,
+                        queue_capacity=16, start=False)
+    futs = [eng.submit(pool[i]) for i in range(3)]
+    eng.start()
+    rows = [f.result(timeout=60) for f in futs]
+    st = eng.stats()
+    eng.close()
+    # 3 requests coalesce into ONE bucket-4 batch: 1 padded slot
+    assert st["batches"] == 1
+    assert st["padded_slots"] == 1
+    assert all(_rows_equal(r, oracle[i]) for i, r in enumerate(rows))
+
+
+def test_zero_recompiles_after_warmup(parts, engine):
+    """Bucket selection NEVER recompiles: after construction (all buckets
+    AOT-compiled) a stream spanning every bucket size fires zero
+    backend-compile events (the PR 6 recompile listener is the pin)."""
+    from real_time_helmet_detection_tpu.obs.telemetry import \
+        install_recompile_counter
+    _, _, _, pool, _ = parts
+    engine.predict_many(pool[:4])  # touch every bucket-sized path once
+    counter = install_recompile_counter()
+    for n in (1, 2, 3, 4, 1):
+        [f.result(timeout=60) for f in
+         [engine.submit(pool[i]) for i in range(n)]]
+    assert counter.count == 0
+
+
+def test_queue_full_sheds_immediately(parts):
+    _, predict, variables, pool, _ = parts
+    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
+                        buckets=(1, 2), max_wait_ms=0.0,
+                        queue_capacity=2, start=False)
+    futs = [eng.submit(pool[0], block=False) for _ in range(5)]
+    shed = [f for f in futs if f.done()]
+    assert len(shed) == 3
+    for f in shed:
+        with pytest.raises(SheddedError):
+            f.result()
+    eng.start()
+    served = [f for f in futs if f not in shed]
+    assert all(f.result(timeout=60) is not None for f in served)
+    st = eng.stats()
+    eng.close()
+    assert st["shed_queue_full"] == 3
+    assert st["completed"] == 2
+
+
+def test_deadline_shed_before_dispatch(parts):
+    _, predict, variables, pool, _ = parts
+    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
+                        buckets=(1, 2), max_wait_ms=0.0,
+                        queue_capacity=8, start=False)
+    late = eng.submit(pool[0], deadline_s=0.001)
+    ok = eng.submit(pool[1])  # no deadline: must still be served
+    time.sleep(0.05)
+    eng.start()
+    with pytest.raises(SheddedError):
+        late.result(timeout=60)
+    assert ok.result(timeout=60) is not None
+    st = eng.stats()
+    eng.close()
+    assert st["shed_deadline"] == 1 and st["completed"] == 1
+
+
+def test_close_fails_pending_and_rejects_new(parts):
+    _, predict, variables, pool, _ = parts
+    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
+                        buckets=(1,), max_wait_ms=0.0,
+                        queue_capacity=4, start=False)
+    fut = eng.submit(pool[0])
+    eng.close()
+    with pytest.raises(EngineClosedError):
+        fut.result(timeout=10)
+    with pytest.raises(EngineClosedError):
+        eng.submit(pool[0])
+
+
+def test_submit_validates_shape_and_dtype(parts, engine):
+    with pytest.raises(ValueError):
+        engine.submit(np.zeros((IMSIZE, IMSIZE, 3), np.float32))
+    with pytest.raises(ValueError):
+        engine.submit(np.zeros((32, 32, 3), np.uint8))
+
+
+def test_spans_cover_the_taxonomy(parts, tmp_path):
+    """The engine's flight-recorder contract: compile spans per bucket at
+    construction, then queue-wait/batch-form/h2d/compute/d2h per batch
+    and e2e per request ($OBS_SPAN_LOG honored via maybe_tracer)."""
+    from real_time_helmet_detection_tpu.obs.spans import (maybe_tracer,
+                                                          read_spans)
+    _, predict, variables, pool, _ = parts
+    path = str(tmp_path / "serve_spans.jsonl")
+    tracer = maybe_tracer(path)
+    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
+                        buckets=(1, 2), max_wait_ms=1.0,
+                        queue_capacity=8, tracer=tracer)
+    eng.predict_many(pool[:3])
+    eng.close()
+    tracer.close()
+    recs = read_spans(path)
+    names = {r.get("name") for r in recs}
+    assert {"serve:compile", "serve:queue-wait", "serve:batch-form",
+            "serve:h2d", "serve:compute", "serve:d2h",
+            "serve:e2e"} <= names
+    assert sum(1 for r in recs if r.get("name") == "serve:compile") == 2
+    assert sum(1 for r in recs if r.get("name") == "serve:e2e") == 3
+
+
+def test_resolve_buckets_contract():
+    assert resolve_buckets(Config()) == tuple(DEFAULT_BUCKETS)
+    assert resolve_buckets(Config(serve_buckets=[8, 2, 2])) == (2, 8)
+    with pytest.raises(ValueError):
+        Config(serve_buckets=[0, 2])
+    with pytest.raises(ValueError):
+        Config(serve_buckets=[])
+
+
+def test_results_in_submission_order_across_batches(parts):
+    """FIFO completion: per-request futures complete in dispatch order
+    even when requests span several partial batches (the eval driver
+    drains its pending deque head-first and relies on this)."""
+    _, predict, variables, pool, oracle = parts
+    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
+                        buckets=BUCKETS, max_wait_ms=0.5, depth=2,
+                        queue_capacity=64)
+    futs = [eng.submit(pool[i % len(pool)]) for i in range(11)]
+    rows = [f.result(timeout=60) for f in futs]
+    eng.close()
+    assert all(_rows_equal(r, oracle[i % len(pool)])
+               for i, r in enumerate(rows))
