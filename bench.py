@@ -79,11 +79,11 @@ DEFAULT_HBM = 819e9  # v5e
 # and runner_drive.py (they diverged in r5: mfu_breakdown defaulted to r05
 # while the rest stayed at r04, scattering same-round artifacts — ADVICE
 # r5 #3); bump it here when a new round starts, or override per-run with
-# $GRAFT_ROUND. r10 = the continuous-batching serving round (ISSUE 8:
-# serving/ engine, serve_bench load curves, per-bucket export); earlier
+# $GRAFT_ROUND. r11 = the chaos/self-healing round (ISSUE 9: fault
+# injection, serving in-flight recovery, train sentinel); earlier
 # rounds' artifact dirs are committed history and must not be
 # overwritten.
-GRAFT_ROUND_DEFAULT = "r10"
+GRAFT_ROUND_DEFAULT = "r11"
 
 # v5e int8 MXU peak (2x the bf16 peak — jax-ml scaling-book): the
 # denominator for int8-path MFU and the hardware case for --infer-dtype
@@ -240,7 +240,8 @@ def find_last_tpu_result(repo_root: str | None = None) -> dict | None:
             "mfu_train", "mfu_fwd", "device_kind", "peak_pallas_us",
             "peak_xla_us", "pallas_matches_xla", "infer_dtype", "int8_fps",
             "int8_vs_bf16", "recompile_count", "loadavg", "param_policy",
-            "epilogue", "serve_p50_ms", "serve_p99_ms", "serve_goodput")
+            "epilogue", "serve_p50_ms", "serve_p99_ms", "serve_goodput",
+            "sentinel", "skipped_steps")
     out.update({k: rec[k] for k in keep if k in rec})
     return out
 
@@ -608,6 +609,13 @@ def _bench(out: dict, hb) -> None:
             log("BENCH_PARAM_POLICY=%s needs bf16 (--amp); forcing fp32"
                 % param_policy)
             param_policy = "fp32"
+        # BENCH_SENTINEL=1 (or --sentinel): the ISSUE-9 in-jit NaN/spike
+        # sentinel rides the timed train program; the scanned skip counter
+        # returns NEXT TO the loss scalar (same single D2H) and lands on
+        # the ONE JSON line as skipped_steps. Off = the exact pre-PR
+        # program, and the line says so (sentinel: "off").
+        sentinel_on = (os.environ.get("BENCH_SENTINEL") == "1"
+                       or "--sentinel" in sys.argv)
         tcfg = Config(num_stack=1, hourglass_inch=128, num_cls=2,
                       batch_size=train_batch, amp=dtype is not None,
                       imsize=imsize,
@@ -615,7 +623,8 @@ def _bench(out: dict, hb) -> None:
                       loss_kernel=os.environ.get("BENCH_LOSS_KERNEL",
                                                  "auto"),
                       param_policy=param_policy,
-                      epilogue=os.environ.get("BENCH_EPILOGUE", "auto"))
+                      epilogue=os.environ.get("BENCH_EPILOGUE", "auto"),
+                      sentinel=sentinel_on)
         tmodel = build_model(tcfg, dtype=dtype)
         tx = build_optimizer(tcfg, 100)
         state = create_train_state(tmodel, tcfg, jax.random.key(0), imsize, tx)
@@ -624,7 +633,8 @@ def _bench(out: dict, hb) -> None:
         arrs = tuple(jnp.asarray(a) for a in synthetic_target_batch(
             train_batch, imsize, pos_rate=0.01))
 
-        train_n = make_scanned_train_fn(body, n_train)
+        train_n = make_scanned_train_fn(body, n_train,
+                                        sentinel=sentinel_on)
         with tracer.span("bench:train-compile", batch=train_batch):
             tcompiled = jax.jit(train_n, donate_argnums=(0,)).lower(
                 state, *arrs).compile()
@@ -645,8 +655,18 @@ def _bench(out: dict, hb) -> None:
         # The program returns (final state, last loss) so every donated
         # buffer has an output to alias (donation actually elides the
         # copy — no "donated buffers were not usable" warning); fetch ONLY
-        # the scalar loss so the full state never crosses D2H.
-        np.asarray(tcompiled(state, *arrs)[1])
+        # the scalar loss (+ the sentinel's skip-count scalar, same fetch)
+        # so the full state never crosses D2H.
+        out["sentinel"] = "on" if sentinel_on else "off"
+        if sentinel_on:
+            warm_loss, warm_skipped = tcompiled(state, *arrs)[1]
+            np.asarray(warm_loss)
+            # the warmup scan ran the same n_train steps on the same
+            # batch as the timed run: its skip count IS the program's
+            out["skipped_steps"] = int(np.asarray(warm_skipped))
+        else:
+            np.asarray(tcompiled(state, *arrs)[1])
+            out["skipped_steps"] = 0
         state = create_train_state(tmodel, tcfg, jax.random.key(0), imsize, tx)
         dt = timed_fetch(lambda *a: tcompiled(*a)[1], (state, *arrs),
                          overhead, repeats=1)

@@ -53,6 +53,17 @@ analogue is manual code review, ref /root/reference/README.md:1):
                           amortize. Results must ride the per-BATCH D2H
                           (`ServingEngine._fetch_loop`, the allowlisted
                           completion point).
+* `unbounded-retry`     — a `while True` retry loop whose except handler
+                          swallows the failure and loops again with no
+                          attempt cap and no backoff: the r2 probe-kill
+                          mistake class (each retried claim probe could
+                          re-wedge the claim; an unbounded reconnect loop
+                          hammers a dead relay forever). Retries must be
+                          bounded (`for attempt in range(N)`) and/or
+                          backed off (`time.sleep` in the loop). Consumer
+                          loops that block on a queue-style `.get()` are
+                          exempt — they re-attempt on NEW work, not the
+                          same failing operation.
 
 Suppression: a `# graftlint: off=<rule>[,<rule>]` comment anywhere inside
 the flagged node's line span disables that rule there — every suppression
@@ -481,10 +492,74 @@ def rule_device_get_in_serving_loop(tree, lines, relpath) -> List[Finding]:
     return out
 
 
+def _subtree_nodes(root) -> Iterable[ast.AST]:
+    """Every node under `root` (inclusive), NOT descending into nested
+    function/class defs — loop analysis must not be confused by a
+    closure's control flow."""
+    stack: List[ast.AST] = [root]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def rule_unbounded_retry(tree, lines, relpath) -> List[Finding]:
+    """`while True` + an except handler that swallows and loops again +
+    no cap, no backoff, no queue-consume (ISSUE 9 satellite — the r2
+    probe-kill mistake class; see the module docstring)."""
+    out = []
+    for qual, node, body in _iter_scopes(tree):
+        loops = [n for stmt in body for n in _subtree_nodes(stmt)
+                 if isinstance(n, ast.While)]
+        for loop in loops:
+            test = loop.test
+            if not (isinstance(test, ast.Constant) and test.value is True):
+                continue
+            nodes = [n for stmt in loop.body for n in _subtree_nodes(stmt)]
+            # a backoff or a blocking queue-consume anywhere in the loop
+            # legitimizes it (bounded-in-time, or a consumer loop)
+            slept = consumes = False
+            for n in nodes:
+                if isinstance(n, ast.Call):
+                    name = _call_name(n)
+                    leaf = name.split(".")[-1]
+                    if leaf == "sleep" or "backoff" in leaf:
+                        slept = True
+                    if leaf == "get" and "." in name:
+                        consumes = True
+            if slept or consumes:
+                continue
+            for n in nodes:
+                if not isinstance(n, ast.ExceptHandler):
+                    continue
+                handler_nodes = [m for stmt in n.body
+                                 for m in _subtree_nodes(stmt)]
+                if any(isinstance(m, (ast.Raise, ast.Return, ast.Break))
+                       for m in handler_nodes):
+                    continue  # the handler exits the loop: bounded
+                if _suppressed("unbounded-retry", lines, n.lineno,
+                               getattr(n, "end_lineno", n.lineno)):
+                    continue
+                out.append(Finding(
+                    rule="ast/unbounded-retry", path=relpath,
+                    line=n.lineno, context=qual,
+                    message="while-True retry loop swallows the exception "
+                            "and loops again with no attempt cap and no "
+                            "backoff (the r2 probe-kill mistake class) — "
+                            "bound it (for attempt in range(N)) and/or "
+                            "back off (time.sleep) before re-attempting"))
+                break  # one finding per loop
+    return out
+
+
 RULES = (rule_per_call_timing, rule_queue_bypass, rule_env_platform_write,
          rule_raw_artifact_write, rule_device_get_in_loop,
          rule_missing_ref_citation, rule_raw_span_timing,
-         rule_device_get_in_serving_loop)
+         rule_device_get_in_serving_loop, rule_unbounded_retry)
 
 
 # ---------------------------------------------------------------------------

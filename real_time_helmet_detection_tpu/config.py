@@ -145,6 +145,17 @@ class Config:
     export_serve: bool = False    # export additionally emits one StableHLO
     # artifact per serve bucket (out_dir/serving/b<N>/) so the C++ runner
     # can serve the same bucket set the Python engine does
+    serve_max_retries: int = 2    # in-flight recovery (ISSUE 9): per-
+    # REQUEST retry budget after a batch dispatch/fetch failure or hang —
+    # requeued requests reuse the same AOT bucket programs, so retried
+    # results stay bit-identical to one-shot predict; budget exhausted
+    # surfaces the error on the future (0 = fail-fast, the pre-PR
+    # behavior)
+    serve_hang_timeout_ms: float = 0.0  # engine fetch watchdog: a batch
+    # D2H exceeding this is declared hung (the tunnel-hang signature) and
+    # its requests requeued. 0 disables (default — on a healthy local
+    # backend the watchdog is pure overhead); on the remote tunnel set it
+    # WELL above the largest bucket's honest p99 fetch time.
 
     # augmentation
     crop_percent: List[float] = field(default_factory=lambda: [0.0, 0.1])
@@ -258,6 +269,29 @@ class Config:
     # near-zero value; a real transport blip needs the full pause)
     fault_inject: str = ""        # debug: "EPOCH:ITER" raises one synthetic
     # transient backend error at that step, to exercise --auto-resume
+    sentinel: bool = False        # self-healing numerics (ISSUE 9): a
+    # fixed-shape NaN/Inf + grad-norm-spike check computed INSIDE the
+    # jitted step; a tripped step is SKIPPED in-jit (the whole TrainState
+    # — params, optimizer moments, batch stats, EMA — keeps its pre-step
+    # value, so one poison batch cannot contaminate a run) and the
+    # sentinel scalars ride the SAME deferred loss fetch (zero extra D2H,
+    # the --telemetry contract). The host-side SentinelMonitor backs the
+    # loss scale off after bad flush windows and triggers an automatic
+    # rollback to the last good checkpoint on sustained divergence. Off
+    # (the default) traces the exact pre-PR step program (bit-identity
+    # pinned by tests/test_sentinel.py). The reference has no numeric
+    # failure handling at all (a NaN poisons the run silently).
+    sentinel_spike: float = 0.0   # grad-norm spike threshold: an
+    # otherwise-finite step whose global grad norm exceeds this is also
+    # skipped (0 disables the spike check — NaN/Inf only). Calibrate from
+    # the telemetry grad_norm history of a healthy run (obs_report).
+    sentinel_backoff: float = 0.5  # loss-scale multiplier applied after a
+    # flush window containing skipped steps (recovers x2 per clean window,
+    # capped at 1.0, floored at 1/1024); 1.0 disables the backoff.
+    sentinel_divergence: int = 3  # consecutive skipped steps that count as
+    # sustained divergence -> rollback to the last good checkpoint
+    sentinel_rollbacks: int = 2   # automatic rollback budget per run (0
+    # disables rollback; the sentinel then only skips and backs off)
     save_path: str = "./WEIGHTS/"
     profile: bool = False         # jax.profiler trace of early train steps
     telemetry: bool = False       # in-jit step telemetry (obs/telemetry.py):
@@ -340,6 +374,24 @@ class Config:
         if self.serve_queue < 1:
             raise ValueError("--serve-queue must be >= 1, got %d"
                              % self.serve_queue)
+        if self.serve_max_retries < 0:
+            raise ValueError("--serve-max-retries must be >= 0, got %d"
+                             % self.serve_max_retries)
+        if self.serve_hang_timeout_ms < 0:
+            raise ValueError("--serve-hang-timeout-ms must be >= 0, got %r"
+                             % (self.serve_hang_timeout_ms,))
+        if self.sentinel_spike < 0:
+            raise ValueError("--sentinel-spike must be >= 0, got %r"
+                             % (self.sentinel_spike,))
+        if not 0.0 < self.sentinel_backoff <= 1.0:
+            raise ValueError("--sentinel-backoff must be in (0, 1], got %r"
+                             % (self.sentinel_backoff,))
+        if self.sentinel_divergence < 1:
+            raise ValueError("--sentinel-divergence must be >= 1, got %d"
+                             % self.sentinel_divergence)
+        if self.sentinel_rollbacks < 0:
+            raise ValueError("--sentinel-rollbacks must be >= 0, got %d"
+                             % self.sentinel_rollbacks)
         if self.loader not in ("thread", "process"):
             raise ValueError("--loader must be 'thread' or 'process', got %r"
                              % self.loader)

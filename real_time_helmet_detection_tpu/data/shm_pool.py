@@ -247,11 +247,24 @@ class ProcessBatchLoader(BatchLoader):
         like the thread loader;
       * a DEAD worker (killed, OOMed, segfaulted) is reaped: the pool is
         terminated and the remainder of the run is produced in-process by
-        the thread path — same bytes, lower throughput, loud warning.
+        the thread path — same bytes, lower throughput, loud warning;
+      * `quarantine=True` (ISSUE 9; armed by train's --sentinel): a
+        produced batch carrying non-finite float values (a poisoned input
+        shard, a decode blowup) is QUARANTINED — counted, reported as a
+        `recover:quarantine` flight-recorder event and dropped before it
+        can reach the train step — instead of burning a step (or, without
+        the in-jit sentinel, silently poisoning the run). Off by default:
+        the finite scan costs a pass over the batch's float bytes.
     """
 
-    def __init__(self, *args, **kw):
+    def __init__(self, *args, quarantine: bool = False, **kw):
         super().__init__(*args, **kw)
+        self.quarantine = bool(quarantine)
+        self.quarantined = 0
+        # one tracer for the loader's recover:quarantine events (honors
+        # $OBS_SPAN_LOG; disabled tracers cost nothing)
+        from ..obs.spans import maybe_tracer
+        self._obs = maybe_tracer() if quarantine else None
         self._ctx = get_context("spawn")
         self._procs: List = []
         self._heartbeats: List = []
@@ -330,7 +343,36 @@ class ProcessBatchLoader(BatchLoader):
                 i, "up" if p.is_alive() else "DEAD", age))
         if self._fell_back:
             parts.append("FELL-BACK-TO-THREAD")
+        if self.quarantined:
+            parts.append("quarantined:%d" % self.quarantined)
         return "loader workers: " + " ".join(parts)
+
+    # -- poison-batch quarantine (ISSUE 9) ---------------------------------
+
+    def _quarantine_batch(self, batch: Batch, batch_idx: int,
+                          epoch: int) -> bool:
+        """True if `batch` is poisoned (non-finite floats) and was
+        quarantined. The scan covers every float field the step consumes;
+        uint8 canvases (raw mode) have nothing to scan — their GT boxes
+        still do."""
+        if not self.quarantine:
+            return False
+        for name in ("image", "heatmap", "offset", "wh", "boxes"):
+            arr = getattr(batch, name, None)
+            if not (isinstance(arr, np.ndarray) and arr.dtype.kind == "f"
+                    and arr.size):
+                continue
+            if not np.isfinite(arr).all():
+                self.quarantined += 1
+                print("process loader: QUARANTINED poisoned batch %d "
+                      "(epoch %d): non-finite values in %r (total "
+                      "quarantined: %d)" % (batch_idx, epoch, name,
+                                            self.quarantined), flush=True)
+                if self._obs is not None:
+                    self._obs.event("recover:quarantine", batch=batch_idx,
+                                    epoch=epoch, field=name)
+                return True
+        return False
 
     # -- iteration ---------------------------------------------------------
 
@@ -342,8 +384,11 @@ class ProcessBatchLoader(BatchLoader):
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(self.num_workers) as pool:
             for bi in range(start_idx, len(chunks)):
-                yield self._make_batch(pool, chunks[bi], epoch=epoch,
-                                       batch_idx=bi)
+                batch = self._make_batch(pool, chunks[bi], epoch=epoch,
+                                         batch_idx=bi)
+                if self._quarantine_batch(batch, bi, epoch):
+                    continue
+                yield batch
 
     def __iter__(self) -> Iterator[Batch]:
         epoch = self.epoch
@@ -393,7 +438,10 @@ class ProcessBatchLoader(BatchLoader):
                     next_dispatch += 1
                 if next_emit in ready:
                     batch = ready.pop(next_emit)
+                    bi_emit = next_emit
                     next_emit += 1
+                    if self._quarantine_batch(batch, bi_emit, epoch):
+                        continue
                     yield batch
                     continue
                 try:

@@ -253,10 +253,16 @@ def evaluate(cfg: Config) -> Dict:
             {b for b in resolve_buckets(cfg) if b <= cfg.batch_size}
             | {cfg.batch_size}))
     depth = max(cfg.serve_depth, 1 + cfg.device_prefetch)
+    # in-flight recovery (ISSUE 9): a transient PJRT error or hung fetch
+    # mid-eval costs a bounded retry of that batch's requests, not the
+    # whole eval run (retries reuse the same AOT programs — bit-identical)
     engine = ServingEngine(
         predict, variables, (int(imsize), int(imsize), 3), np.uint8,
         buckets=buckets, max_wait_ms=cfg.serve_max_wait_ms, depth=depth,
-        queue_capacity=cfg.serve_queue, sharding=sharding, tracer=tracer)
+        queue_capacity=cfg.serve_queue, sharding=sharding, tracer=tracer,
+        max_retries=cfg.serve_max_retries,
+        hang_timeout_s=(cfg.serve_hang_timeout_ms / 1e3
+                        if cfg.serve_hang_timeout_ms > 0 else None))
 
     from collections import deque
     pending: "deque" = deque()  # (futures, infos) per loader batch

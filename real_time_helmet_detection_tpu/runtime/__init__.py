@@ -6,6 +6,8 @@ initializing any JAX backend. The in-process robustness layer
 process boundary; this package supervises the *jobs*:
 
 * `errors`     — one transient-vs-permanent classifier for all layers
+* `faults`     — deterministic seeded fault injection (the chaos layer
+                 the self-healing serving/train paths are tested against)
 * `heartbeat`  — HangWatchdog (in-process) + FileHeartbeat (cross-process)
 * `spool`      — persistent fsynced JSON-lines job journal
 * `supervisor` — relay/claim triage, hang-kill-salvage, backoff requeue
@@ -15,8 +17,11 @@ see CLAUDE.md and docs/ARCHITECTURE.md "Failure domains & supervision").
 """
 
 from .errors import (EXIT_TRANSIENT, InjectedBackendError,  # noqa: F401
-                     classify_error_text, classify_exception,
-                     is_transient_backend_error)
+                     TrainingDivergenceError, classify_error_text,
+                     classify_exception, is_transient_backend_error)
+from .faults import (ALL_SITES, FAULT_KINDS, SERVE_SITES,  # noqa: F401
+                     TRAIN_SITES, ChaosInjector, FaultEvent, FaultSchedule,
+                     maybe_injector)
 from .heartbeat import (FileHeartbeat, HangWatchdog,  # noqa: F401
                         heartbeat_age_s, maybe_job_heartbeat,
                         read_heartbeat, run_as_job, write_job_status)

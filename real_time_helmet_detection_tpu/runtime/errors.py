@@ -42,6 +42,16 @@ class InjectedBackendError(RuntimeError):
     """Synthetic transient backend failure raised by FaultInjector."""
 
 
+class TrainingDivergenceError(RuntimeError):
+    """Sustained numeric divergence detected by the train sentinel
+    (ISSUE 9): >= cfg.sentinel_divergence consecutive steps tripped the
+    in-jit NaN/Inf/grad-spike check. NOT a backend failure — the device
+    is healthy, the numerics are not — so it is deliberately NOT
+    transient for `is_transient_backend_error` (a backend re-init would
+    not help); train() handles it with its own checkpoint-rollback
+    branch, bounded by cfg.sentinel_rollbacks."""
+
+
 def is_transient_backend_error(e: BaseException) -> bool:
     """Would retrying after a backend re-init plausibly succeed?"""
     if isinstance(e, InjectedBackendError):

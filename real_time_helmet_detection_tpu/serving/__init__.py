@@ -8,10 +8,12 @@ admission control. See `engine.py` and docs/ARCHITECTURE.md "Serving
 engine".
 """
 
-from .engine import (DEFAULT_BUCKETS, EngineClosedError, ServeFuture,
+from .engine import (CLOSED, DEFAULT_BUCKETS, DEGRADED, DRAINING, SERVING,
+                     EngineClosedError, FetchHungError, ServeFuture,
                      ServingEngine, SheddedError, resolve_buckets)
 
 __all__ = [
-    "DEFAULT_BUCKETS", "EngineClosedError", "ServeFuture", "ServingEngine",
+    "CLOSED", "DEFAULT_BUCKETS", "DEGRADED", "DRAINING", "SERVING",
+    "EngineClosedError", "FetchHungError", "ServeFuture", "ServingEngine",
     "SheddedError", "resolve_buckets",
 ]

@@ -1,4 +1,5 @@
-"""Continuous-batching serving engine: bucketed AOT predict + pipelining.
+"""Continuous-batching serving engine: bucketed AOT predict + pipelining
++ in-flight recovery.
 
 The reference serves one frame per invocation through its C++ app (ref
 README.md:76, export.py:55); the closest thing this repo had was the
@@ -8,9 +9,11 @@ server: many concurrent low-latency streams need *dynamic micro-batching*
 waiting forever) plus *multiple in-flight batches* (H2D, compute and D2H
 of consecutive batches overlap) plus *admission control* (bounded queue,
 deadline shedding — an overloaded server that queues unboundedly serves
-nobody: every response arrives too late). This engine is that system, and
-it is the ONE predict surface eval, demo, bench, serve_bench and the
-per-bucket export all sit on.
+nobody: every response arrives too late) plus *in-flight recovery* (a
+PJRT error or a hung D2H mid-batch must cost a retry, not the engine —
+the repo's own relay has died mid-round, CLAUDE.md). This engine is that
+system, and it is the ONE predict surface eval, demo, bench, serve_bench
+and the per-bucket export all sit on.
 
 Design rules, each load-bearing:
 
@@ -20,11 +23,13 @@ Design rules, each load-bearing:
   construction from the SAME `make_predict_fn` program eval uses. After
   `__init__` returns, serving never traces or compiles again — bucket
   selection is a table lookup (tests pin zero recompiles via the PR 6
-  listener). Padding rows are zeros; they are never read back (each
-  request gets exactly its own row), and per-row results are
-  bit-identical to a one-shot predict of the same image regardless of
-  bucket or co-batched neighbors (per-image independence of the predict
-  program; property-tested in tests/test_serving.py).
+  listener), and RETRIES reuse the same executables, which is why a
+  retried request's result is bit-identical to its one-shot predict.
+  Padding rows are zeros; they are never read back (each request gets
+  exactly its own row), and per-row results are bit-identical to a
+  one-shot predict of the same image regardless of bucket or co-batched
+  neighbors (per-image independence of the predict program;
+  property-tested in tests/test_serving.py).
 * **Batching policy = max-wait vs max-batch.** The dispatcher takes the
   oldest queued request, then accumulates until either the largest
   bucket fills or `max_wait_ms` has elapsed since that request was
@@ -46,6 +51,34 @@ Design rules, each load-bearing:
   requests whose deadline passed before batch formation are shed
   instead of wasting a bucket slot. Shed events land in the flight
   recorder (`serve:shed`).
+* **In-flight recovery (ISSUE 9).** A batch that fails at dispatch or
+  fetch — or whose fetch exceeds the `hang_timeout_s` watchdog (the
+  tunnel-hang signature: a D2H that never completes) — does not fail
+  its requests outright: each constituent request is requeued with a
+  bounded per-request retry budget (`max_retries`; budget exhausted =>
+  the error surfaces on that future, never silently). Requeues ride an
+  internal deque the dispatcher drains FIRST, so recovery does not
+  contend with admission control for queue capacity. The engine
+  transitions SERVING -> DEGRADED on a batch failure and back after
+  `recover_after` consecutive healthy batches; `health()` snapshots the
+  state machine for load balancers / the chaos suite. Recovery is
+  flight-recorder evidence: `recover:requeue` / `recover:retry-
+  exhausted` events and `serve:state` transitions join the `fault:*`
+  injections in obs_report's Faults section.
+* **Graceful drain + hot reload.** `reload(variables, ...)` drains
+  everything already admitted (served with the OLD weights), swaps the
+  device-committed weights under the dispatch mutex, and resumes — no
+  acknowledged request is dropped and no request ever sees a
+  half-swapped checkpoint. The engine passes `variables` as a call
+  argument to the AOT executables (never closes over them), which is
+  what makes the swap possible without recompiling a single bucket.
+* **Deterministic chaos hooks.** An optional `runtime.faults.
+  ChaosInjector` fires at the `serve:dispatch` / `serve:fetch` sites;
+  with `injector=None` (production default) the hot loops skip even the
+  attribute check. The chaos property suite (tests/test_chaos.py)
+  replays seeded schedules of device-loss/hung-fetch/slow-batch against
+  the engine and asserts zero acknowledged requests are lost and every
+  survivor is bit-identical to one-shot predict.
 * **Flight-recorder spans.** `serve:queue-wait` / `serve:batch-form` /
   `serve:h2d` / `serve:compute` (async dispatch walls) / `serve:d2h`
   (the fetch — where un-hidden device time surfaces, exactly like
@@ -55,6 +88,7 @@ Design rules, each load-bearing:
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
@@ -64,7 +98,16 @@ import numpy as np
 
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16)
 
+# engine states (the ISSUE 9 state machine; docs/ARCHITECTURE.md "Fault
+# injection & self-healing" has the transition table)
+SERVING = "serving"      # healthy steady state
+DEGRADED = "degraded"    # >=1 recent batch failure; still serving, retries
+# in flight; exits after `recover_after` consecutive healthy batches
+DRAINING = "draining"    # reload(): serving admitted work, not yet swapped
+CLOSED = "closed"        # terminal
+
 _SENTINEL = object()
+_WAKE = object()         # fetcher->dispatcher nudge: "check the retry deque"
 
 
 class SheddedError(RuntimeError):
@@ -75,6 +118,13 @@ class SheddedError(RuntimeError):
 
 class EngineClosedError(RuntimeError):
     """The engine was closed before this request completed."""
+
+
+class FetchHungError(RuntimeError):
+    """A batch's D2H exceeded the hang watchdog (`hang_timeout_s`) — the
+    remote-tunnel hang signature (CLAUDE.md): completion that never
+    arrives. The batch's requests are requeued; the stuck fetch is
+    abandoned (its eventual result, if any, is discarded)."""
 
 
 def resolve_buckets(cfg) -> Tuple[int, ...]:
@@ -95,7 +145,8 @@ class ServeFuture:
     """Completion handle for one request. `result()` blocks; a shed or
     engine-close surfaces as the recorded exception. `t_submit`/`t_done`
     (monotonic) let load generators compute client-side latency without
-    re-timing."""
+    re-timing. Completion is FIRST-WINS: a hang-abandoned fetch that
+    eventually lands cannot overwrite the retry's result."""
 
     __slots__ = ("_event", "_value", "_error", "t_submit", "t_done",
                  "deadline")
@@ -108,15 +159,21 @@ class ServeFuture:
         self.t_done: Optional[float] = None
         self.deadline = deadline
 
-    def _set(self, value) -> None:
+    def _set(self, value) -> bool:
+        if self._event.is_set():
+            return False
         self._value = value
         self.t_done = time.monotonic()
         self._event.set()
+        return True
 
-    def _fail(self, error: BaseException) -> None:
+    def _fail(self, error: BaseException) -> bool:
+        if self._event.is_set():
+            return False
         self._error = error
         self.t_done = time.monotonic()
         self._event.set()
+        return True
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -131,11 +188,12 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("image", "future")
+    __slots__ = ("image", "future", "attempts")
 
     def __init__(self, image: np.ndarray, future: ServeFuture):
         self.image = image
         self.future = future
+        self.attempts = 0    # completed dispatch attempts that failed
 
 
 class ServingEngine:
@@ -147,7 +205,8 @@ class ServingEngine:
         `(variables, images(B,H,W,C)) -> Detections` — batch-shape
         polymorphic under AOT lowering; eval/demo/export pass exactly the
         fn they already use.
-    variables : checkpoint pytree, device-committed once at construction.
+    variables : checkpoint pytree, device-committed once at construction
+        (hot-swappable later via `reload`).
     image_shape : (H, W, C) static per-request shape.
     image_dtype : np dtype of the wire (uint8 for the raw eval wire).
     buckets : static batch-size set, AOT-compiled at construction.
@@ -161,13 +220,23 @@ class ServingEngine:
         $OBS_SPAN_LOG.
     start : tests may construct paused (`start=False`) to exercise
         admission control deterministically, then call `.start()`.
+    max_retries : per-REQUEST retry budget after a batch failure/hang
+        (0 restores the pre-recovery fail-fast behavior).
+    hang_timeout_s : fetch watchdog — a batch D2H exceeding this is
+        treated as hung and its requests requeued (None disables; keep
+        it well above the honest p99 fetch time for the largest bucket).
+    recover_after : consecutive healthy batches that clear DEGRADED.
+    injector : optional `runtime.faults.ChaosInjector` for deterministic
+        fault replay (tests/serve_bench --faults); None = zero overhead.
     """
 
     def __init__(self, predict, variables, image_shape: Sequence[int],
                  image_dtype, buckets: Sequence[int] = DEFAULT_BUCKETS,
                  max_wait_ms: float = 5.0, depth: int = 2,
                  queue_capacity: int = 128, sharding=None, tracer=None,
-                 start: bool = True):
+                 start: bool = True, max_retries: int = 2,
+                 hang_timeout_s: Optional[float] = None,
+                 recover_after: int = 2, injector=None):
         import jax
 
         from ..obs.spans import maybe_tracer
@@ -181,13 +250,13 @@ class ServingEngine:
         self._depth = max(1, int(depth))
         self._sharding = sharding
         self._tracer = tracer if tracer is not None else maybe_tracer()
+        self._max_retries = max(0, int(max_retries))
+        self._hang_timeout_s = (None if hang_timeout_s is None
+                                else max(1e-3, float(hang_timeout_s)))
+        self._recover_after = max(1, int(recover_after))
+        self._injector = injector
 
-        if sharding is not None:
-            from ..parallel import replicated
-            self._variables = jax.device_put(
-                variables, replicated(sharding.mesh))
-        else:
-            self._variables = jax.device_put(variables)
+        self._variables = self._commit_variables(variables)
         # AOT: one compile per bucket, at construction, from the SAME
         # predict program — the serve path never traces again
         self._compiled: Dict[int, object] = {}
@@ -200,11 +269,24 @@ class ServingEngine:
 
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1,
                                                          int(queue_capacity)))
+        self._retry: "collections.deque" = collections.deque()
         self._inflight: "queue.Queue" = queue.Queue(maxsize=self._depth)
         self._lock = threading.Lock()
+        # serializes batch dispatch against reload's weight swap; the
+        # dispatcher holds it across one batch's form+H2D+compute
+        self._dispatch_mutex = threading.Lock()
         self._stats = {"submitted": 0, "completed": 0, "batches": 0,
                        "shed_queue_full": 0, "shed_deadline": 0,
-                       "padded_slots": 0, "failed": 0}
+                       "padded_slots": 0, "failed": 0, "retried": 0,
+                       "requeued_batches": 0, "hung_batches": 0,
+                       "failed_batches": 0, "reloads": 0}
+        self._state = SERVING
+        self._consecutive_failures = 0
+        self._consecutive_ok = 0
+        self._inflight_batches = 0
+        self._dispatch_busy = False  # a batch is being formed/dispatched
+        # (visible to _is_idle: batch formation can last max_wait_ms)
+        self._last_error: Optional[str] = None
         self._closed = False
         self._started = False
         self._dispatcher = threading.Thread(target=self._dispatch_loop,
@@ -214,6 +296,14 @@ class ServingEngine:
                                          daemon=True, name="serve-fetch")
         if start:
             self.start()
+
+    def _commit_variables(self, variables):
+        import jax
+        if self._sharding is not None:
+            from ..parallel import replicated
+            return jax.device_put(variables,
+                                  replicated(self._sharding.mesh))
+        return jax.device_put(variables)
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -235,20 +325,96 @@ class ServingEngine:
             # a full queue, which the dispatcher is actively draining
             self._dispatcher.join()
             self._fetcher.join()
-        # anything still queued (engine never started, or raced close)
+        # anything still queued (engine never started, raced close, or
+        # retries stranded behind the sentinel)
         while True:
             try:
                 req = self._q.get_nowait()
             except queue.Empty:
                 break
-            if req is not _SENTINEL:
+            if req not in (_SENTINEL, _WAKE):
                 req.future._fail(EngineClosedError("engine closed"))
+        while self._retry:
+            self._retry.popleft().future._fail(
+                EngineClosedError("engine closed"))
+        self._set_state(CLOSED)
 
     def __enter__(self) -> "ServingEngine":
         return self
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    # ---- state machine ---------------------------------------------------
+
+    def _set_state(self, new: str) -> None:
+        with self._lock:
+            old = self._state
+            if old == new or old == CLOSED:
+                return
+            self._state = new
+        self._tracer.event("serve:state", **{"from": old, "to": new})
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def health(self) -> Dict:
+        """Point-in-time health snapshot (the load-balancer / chaos-suite
+        API): state machine position, backlog depths, failure counters."""
+        with self._lock:
+            stats = dict(self._stats)
+            consec_fail = self._consecutive_failures
+            inflight = self._inflight_batches
+            last_error = self._last_error
+        return {"state": self._state, "queued": self._q.qsize(),
+                "retry_queued": len(self._retry),
+                "inflight_batches": inflight,
+                "consecutive_failures": consec_fail,
+                "buckets": list(self._buckets),
+                "max_retries": self._max_retries,
+                "hang_timeout_s": self._hang_timeout_s,
+                "last_error": last_error, "stats": stats}
+
+    def _is_idle(self) -> bool:
+        with self._lock:
+            inflight = self._inflight_batches
+            forming = self._dispatch_busy
+        return (self._q.qsize() == 0 and not self._retry
+                and inflight == 0 and not forming)
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait until everything admitted so far has completed (queues
+        empty, zero in-flight batches). Returns False on timeout. Rare
+        control-path polling, not a hot loop."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while not self._is_idle():
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.002)
+        return True
+
+    def reload(self, variables, timeout_s: float = 30.0) -> None:
+        """Hot checkpoint/scales swap: drain admitted work (served with
+        the OLD weights), swap the device-committed variables under the
+        dispatch mutex, resume. Zero recompiles (the AOT executables take
+        variables as a call argument) and zero dropped requests; requests
+        admitted during the drain are served with the NEW weights."""
+        if self._closed:
+            raise EngineClosedError("engine closed")
+        self._set_state(DRAINING)
+        with self._tracer.span("recover:reload"):
+            if not self.drain(timeout_s):
+                self._set_state(DEGRADED)
+                raise TimeoutError(
+                    "reload: engine did not drain within %.1fs" % timeout_s)
+            with self._dispatch_mutex:
+                # dispatcher is between batches: nothing references the
+                # old weights; anything queued dispatches with the new
+                self._variables = self._commit_variables(variables)
+                with self._lock:
+                    self._stats["reloads"] += 1
+        self._set_state(SERVING)
 
     # ---- client API ------------------------------------------------------
 
@@ -270,7 +436,9 @@ class ServingEngine:
         bucket slot. `block=False` is the admission-control edge: a full
         queue sheds NOW (`SheddedError` raised from `result()`), it never
         stalls the caller — pipelined producers (eval) keep the default
-        blocking backpressure instead."""
+        blocking backpressure instead. An admitted (non-shed) request is
+        ACKNOWLEDGED: it completes with a result or a surfaced error,
+        never disappears (the chaos suite's zero-lost-acks invariant)."""
         if self._closed:
             raise EngineClosedError("engine closed")
         image = np.asarray(image)
@@ -300,6 +468,55 @@ class ServingEngine:
         futs = [self.submit(img) for img in images]
         return [f.result() for f in futs]
 
+    # ---- recovery --------------------------------------------------------
+
+    def _requeue_or_fail(self, live: List[_Request], error: BaseException,
+                         stage: str, b: int) -> None:
+        """Batch failed at `stage`: requeue each request inside its retry
+        budget, surface the error on the rest. The retry deque is drained
+        ahead of the admission queue, and a _WAKE token pops a dispatcher
+        blocked in q.get() so recovery never waits for fresh traffic."""
+        retried, exhausted = 0, 0
+        for r in live:
+            r.attempts += 1
+            if r.attempts <= self._max_retries:
+                self._retry.append(r)
+                retried += 1
+            else:
+                exhausted += 1
+                r.future._fail(error)
+        with self._lock:
+            self._stats["failed_batches"] += 1
+            self._stats["retried"] += retried
+            self._stats["failed"] += exhausted
+            if retried:
+                self._stats["requeued_batches"] += 1
+            self._consecutive_failures += 1
+            self._consecutive_ok = 0
+            self._last_error = "%s: %s" % (type(error).__name__,
+                                           str(error).splitlines()[0][:200]
+                                           if str(error) else "")
+        self._set_state(DEGRADED)
+        self._tracer.event("recover:requeue", stage=stage, b=b, n=retried,
+                           error=type(error).__name__)
+        if exhausted:
+            self._tracer.event("recover:retry-exhausted", stage=stage,
+                               n=exhausted, error=type(error).__name__)
+        if retried:
+            try:
+                self._q.put_nowait(_WAKE)
+            except queue.Full:
+                pass  # a full queue means the dispatcher wakes anyway
+
+    def _note_batch_ok(self) -> None:
+        with self._lock:
+            self._consecutive_ok += 1
+            self._consecutive_failures = 0
+            recovered = (self._state == DEGRADED
+                         and self._consecutive_ok >= self._recover_after)
+        if recovered:
+            self._set_state(SERVING)
+
     # ---- dispatcher ------------------------------------------------------
 
     def _pick_bucket(self, n: int) -> int:
@@ -322,70 +539,139 @@ class ServingEngine:
                 live.append(r)
         return live
 
+    def _take_blocking(self):
+        """Next request, retries first; blocks on the admission queue.
+        Returns _SENTINEL at shutdown."""
+        while True:
+            if self._retry:
+                return self._retry.popleft()
+            item = self._q.get()
+            if item is _WAKE:
+                continue
+            return item
+
+    def _poll_next(self, timeout_s: float):
+        """Non-blocking-ish intake used during batch accumulation:
+        retries first, then the queue with `timeout_s` (<=0 = no wait).
+        None = nothing available in time."""
+        if self._retry:
+            return self._retry.popleft()
+        try:
+            item = (self._q.get_nowait() if timeout_s <= 0
+                    else self._q.get(timeout=timeout_s))
+        except queue.Empty:
+            return None
+        if item is _WAKE:
+            if self._retry:
+                return self._retry.popleft()
+            return None
+        return item
+
     def _dispatch_loop(self) -> None:
         import jax
 
         maxb = self._buckets[-1]
         stop = False
         while not stop:
-            req = self._q.get()
+            req = self._take_blocking()
             if req is _SENTINEL:
                 break
+            with self._lock:
+                self._dispatch_busy = True
             batch = [req]
             # max-wait vs max-batch: anchor on the FIRST request's submit
             # time; under backlog (anchor already expired) drain without
             # waiting so a saturated server runs full buckets
             anchor = req.future.t_submit + self._max_wait_s
             while len(batch) < maxb:
-                rem = anchor - time.monotonic()
-                try:
-                    nxt = (self._q.get_nowait() if rem <= 0
-                           else self._q.get(timeout=rem))
-                except queue.Empty:
-                    break
+                nxt = self._poll_next(anchor - time.monotonic())
+                if nxt is None:
+                    if anchor - time.monotonic() <= 0:
+                        break
+                    continue
                 if nxt is _SENTINEL:
                     stop = True
                     break
                 batch.append(nxt)
             live = self._shed_expired(batch, time.monotonic())
             if not live:
-                continue
-            with self._tracer.span("serve:batch-form", n=len(live)):
-                b = self._pick_bucket(len(live))
-                # a fresh buffer per batch: the async H2D of the previous
-                # dispatch may still be reading its buffer
-                buf = np.zeros((b,) + self._image_shape, self._image_dtype)
-                for i, r in enumerate(live):
-                    buf[i] = r.image
-            now = time.monotonic()
-            for r in live:
-                self._tracer.record("serve:queue-wait",
-                                    now - r.future.t_submit)
-            try:
-                with self._tracer.span("serve:h2d", b=b):
-                    dev = (jax.device_put(buf, self._sharding)
-                           if self._sharding is not None
-                           else jax.device_put(buf))
-                with self._tracer.span("serve:compute", b=b):
-                    out = self._compiled[b](self._variables, dev)
-            except Exception as e:  # noqa: BLE001 — fail the batch, serve on
                 with self._lock:
-                    self._stats["failed"] += len(live)
-                for r in live:
-                    r.future._fail(e)
+                    self._dispatch_busy = False
                 continue
-            with self._lock:
-                self._stats["batches"] += 1
-                self._stats["padded_slots"] += b - len(live)
+            with self._dispatch_mutex:
+                with self._tracer.span("serve:batch-form", n=len(live)):
+                    b = self._pick_bucket(len(live))
+                    # a fresh buffer per batch: the async H2D of the
+                    # previous dispatch may still be reading its buffer
+                    buf = np.zeros((b,) + self._image_shape,
+                                   self._image_dtype)
+                    for i, r in enumerate(live):
+                        buf[i] = r.image
+                now = time.monotonic()
+                for r in live:
+                    self._tracer.record("serve:queue-wait",
+                                        now - r.future.t_submit)
+                try:
+                    if self._injector is not None:
+                        self._injector.fire("serve:dispatch", b=b)
+                    with self._tracer.span("serve:h2d", b=b):
+                        dev = (jax.device_put(buf, self._sharding)
+                               if self._sharding is not None
+                               else jax.device_put(buf))
+                    with self._tracer.span("serve:compute", b=b):
+                        out = self._compiled[b](self._variables, dev)
+                except Exception as e:  # noqa: BLE001 — requeue, serve on
+                    self._requeue_or_fail(live, e, stage="dispatch", b=b)
+                    with self._lock:
+                        self._dispatch_busy = False
+                    continue
+                with self._lock:
+                    self._stats["batches"] += 1
+                    self._stats["padded_slots"] += b - len(live)
+                    self._inflight_batches += 1
+                    self._dispatch_busy = False
             self._inflight.put((out, live, b))  # depth-bounded: blocks at
             # `depth` in-flight batches — the pipelining backpressure
         self._inflight.put(_SENTINEL)
 
     # ---- fetcher ---------------------------------------------------------
 
-    def _fetch_loop(self) -> None:
+    def _fetch(self, out, b: int):
+        """The batch D2H, under the hang watchdog when configured. The
+        fetch runs in a short-lived worker thread ONLY so a hang can be
+        abandoned (the thread is daemonic; a late result is discarded —
+        futures are first-wins); without a watchdog it runs inline."""
         import jax
+        if self._hang_timeout_s is None:
+            if self._injector is not None:
+                self._injector.fire("serve:fetch", b=b)
+            return jax.device_get(out)
+        box: Dict = {}
+        done = threading.Event()
 
+        def _d2h():
+            try:
+                if self._injector is not None:
+                    self._injector.fire("serve:fetch", b=b)
+                box["v"] = jax.device_get(out)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                box["e"] = e
+            finally:
+                done.set()
+
+        th = threading.Thread(target=_d2h, daemon=True, name="serve-d2h")
+        th.start()
+        if not done.wait(self._hang_timeout_s):
+            with self._lock:
+                self._stats["hung_batches"] += 1
+            raise FetchHungError(
+                "batch (bucket %d) D2H exceeded the %.3fs hang watchdog"
+                % (b, self._hang_timeout_s))
+        if "e" in box:
+            raise box["e"]
+        return box["v"]
+
+    def _fetch_loop(self) -> None:
         while True:
             item = self._inflight.get()
             if item is _SENTINEL:
@@ -396,12 +682,11 @@ class ServingEngine:
                     # the ONE sanctioned batched fetch (graftlint
                     # ast/device-get-in-serving-loop polices per-request
                     # fetches; this one D2H serves the whole batch)
-                    host = jax.device_get(out)
-            except Exception as e:  # noqa: BLE001 — fail the batch, serve on
+                    host = self._fetch(out, b)
+            except Exception as e:  # noqa: BLE001 — requeue, serve on
+                self._requeue_or_fail(live, e, stage="fetch", b=b)
                 with self._lock:
-                    self._stats["failed"] += len(live)
-                for r in live:
-                    r.future._fail(e)
+                    self._inflight_batches -= 1
                 continue
             with self._lock:
                 self._stats["completed"] += len(live)
@@ -414,3 +699,6 @@ class ServingEngine:
                                            for leaf in host)))
                 self._tracer.record(
                     "serve:e2e", r.future.t_done - r.future.t_submit, b=b)
+            with self._lock:
+                self._inflight_batches -= 1
+            self._note_batch_ok()

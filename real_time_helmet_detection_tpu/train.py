@@ -51,6 +51,7 @@ from .parallel import (batch_sharding, init_distributed, make_mesh,
 # job supervisor shares them); re-exported here so existing imports
 # (`from ...train import HangWatchdog`) keep working.
 from .runtime.errors import (InjectedBackendError,  # noqa: F401
+                             TrainingDivergenceError,
                              is_transient_backend_error)
 from .runtime.heartbeat import HEARTBEAT_ENV, HangWatchdog  # noqa: F401
 from .utils import AverageMeter, blend_heatmap, save_json, timestamp
@@ -210,27 +211,82 @@ def _optimizer_update(state: TrainState, tx, cfg: Config, grads,
                          ema_params=ema)
 
 
+def _sentinel_update(cfg: Config, state: TrainState, tx, grads, batch_stats,
+                     losses, loss_scale):
+    """The sentinel step tail (ISSUE 9; only traced when cfg.sentinel):
+    in-jit NaN/Inf + grad-spike check, SKIP-STEP on a tripped batch — the
+    whole TrainState (params, optimizer moments, batch stats, EMA stream,
+    step counter) keeps its pre-step value via one fixed-shape select, so
+    a poison batch can never contaminate optimizer state — and the
+    sentinel scalars join the losses dict that rides the existing
+    deferred loss fetch (zero extra D2H; the --telemetry contract)."""
+    import optax
+    gn = optax.global_norm(grads).astype(jnp.float32)
+    bad = jnp.logical_or(jnp.logical_not(jnp.isfinite(losses["total"])),
+                         jnp.logical_not(jnp.isfinite(gn)))
+    if cfg.sentinel_spike > 0:
+        bad = jnp.logical_or(bad, gn > cfg.sentinel_spike)
+    new_state = _optimizer_update(state, tx, cfg, grads, batch_stats)
+    # XLA select: the NaN branch's values are never propagated, and every
+    # old-state buffer has a same-aval output to alias under donation
+    out_state = jax.tree.map(lambda o, n: jnp.where(bad, o, n), state,
+                             new_state)
+    out_losses = dict(_maybe_telemetry(cfg, losses, grads, state.params,
+                                       out_state))
+    out_losses["sentinel_bad"] = bad.astype(jnp.float32)
+    out_losses["sentinel_grad_norm"] = gn
+    out_losses["sentinel_scale"] = jnp.asarray(loss_scale, jnp.float32)
+    return out_state, out_losses
+
+
 def make_train_step_body(model, tx, cfg: Config):
     """The un-jitted train-step body: fwd + bwd + optimizer update.
 
     Exposed separately from `make_train_step` so callers that need the step
     *inside* another XLA program (bench.py scans N steps in one dispatch to
     time steady-state compute without per-dispatch overhead) can reuse the
-    exact production step."""
-    def step(state: TrainState, images, gt_heat, gt_off, gt_wh, mask):
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (_, (batch_stats, losses)), grads = grad_fn(
-            state.params, state.batch_stats, model, images, gt_heat, gt_off,
-            gt_wh, mask, cfg)
-        new_state = _optimizer_update(state, tx, cfg, grads, batch_stats)
-        return new_state, _maybe_telemetry(cfg, losses, grads, state.params,
-                                           new_state)
+    exact production step.
 
+    `--sentinel` (ISSUE 9) grows the signature by one trailing f32
+    `loss_scale` argument (the host-side backoff lever; the loss is scaled
+    before backward and the grads unscaled after, guarding the bf16
+    backward against overflow) and routes the update through
+    `_sentinel_update`'s skip-step select. Sentinel OFF keeps the exact
+    pre-PR body (bit-identity pinned by tests/test_sentinel.py); the
+    built step carries `step.sentinel` so wrappers (scan, runners) adapt."""
+    if not getattr(cfg, "sentinel", False):
+        def step(state: TrainState, images, gt_heat, gt_off, gt_wh, mask):
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            (_, (batch_stats, losses)), grads = grad_fn(
+                state.params, state.batch_stats, model, images, gt_heat,
+                gt_off, gt_wh, mask, cfg)
+            new_state = _optimizer_update(state, tx, cfg, grads, batch_stats)
+            return new_state, _maybe_telemetry(cfg, losses, grads,
+                                               state.params, new_state)
+
+        step.sentinel = False
+        return step
+
+    def step(state: TrainState, images, gt_heat, gt_off, gt_wh, mask,
+             loss_scale):
+        def scaled_loss(params, batch_stats):
+            total, aux = loss_fn(params, batch_stats, model, images,
+                                 gt_heat, gt_off, gt_wh, mask, cfg)
+            return total * loss_scale, aux
+
+        grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
+        (_, (batch_stats, losses)), grads = grad_fn(state.params,
+                                                    state.batch_stats)
+        grads = jax.tree.map(lambda g: g / loss_scale, grads)
+        return _sentinel_update(cfg, state, tx, grads, batch_stats, losses,
+                                loss_scale)
+
+    step.sentinel = True
     return step
 
 
 def make_scanned_train_fn(body, n: int, telemetry: bool = False,
-                          ring_capacity: int = 64):
+                          ring_capacity: int = 64, sentinel: bool = False):
     """`n` sequential train steps inside ONE XLA program (`lax.scan` over a
     `make_train_step_body` step), returning (final TrainState, last total
     loss).
@@ -256,7 +312,35 @@ def make_scanned_train_fn(body, n: int, telemetry: bool = False,
     returns NEXT TO the loss scalar — out[1] becomes (last_total, ring),
     fetched in the SAME single D2H (a few KiB; decode on host with
     `ring_to_host`). Telemetry off keeps the exact pre-PR signature and
-    program."""
+    program.
+
+    `sentinel=True` (ISSUE 9; requires a `--sentinel` body, which takes a
+    trailing loss_scale arg — the scan pins it at 1.0) accumulates the
+    in-jit skip count through the carry instead: out[1] becomes
+    (last_total, skipped_steps int32), same single D2H — how bench.py
+    puts `skipped_steps` on its ONE JSON line. Mutually exclusive with
+    telemetry (the combined carry has no consumer; pick one)."""
+    if sentinel and telemetry:
+        raise ValueError("make_scanned_train_fn: telemetry and sentinel "
+                         "rings are mutually exclusive — pick one")
+    if sentinel:
+        if not getattr(body, "sentinel", False):
+            raise ValueError(
+                "make_scanned_train_fn(sentinel=True) needs a step body "
+                "built with cfg.sentinel=True")
+
+        def train_n(state, images, heat, off, wh, mask):
+            def sbody(carry, _):
+                st, skipped = carry
+                st, losses = body(st, images, heat, off, wh, mask,
+                                  jnp.float32(1.0))
+                skipped = skipped + losses["sentinel_bad"].astype(jnp.int32)
+                return (st, skipped), losses["total"]
+            carry0 = (state, jnp.zeros((), jnp.int32))
+            (st, skipped), totals = jax.lax.scan(sbody, carry0, None,
+                                                 length=n)
+            return st, (totals[-1], skipped)
+        return train_n
     if not telemetry:
         def train_n(state, images, heat, off, wh, mask):
             def sbody(st, _):
@@ -334,12 +418,16 @@ def make_train_step(model, tx, cfg: Config, mesh):
     step = make_train_step_body(model, tx, cfg)
     repl = replicated(mesh)
     # Shardings: state fully replicated; image NHWC and target maps shard
-    # (data on B, spatial on H).
+    # (data on B, spatial on H). The sentinel body's trailing loss_scale
+    # scalar replicates.
     img_sh = batch_sharding(mesh, 4, spatial_dim=1)
     map_sh = batch_sharding(mesh, 4, spatial_dim=1)
+    in_sh = (repl, img_sh, map_sh, map_sh, map_sh, map_sh)
+    if getattr(step, "sentinel", False):
+        in_sh = in_sh + (repl,)
     return jax.jit(
         step,
-        in_shardings=(repl, img_sh, map_sh, map_sh, map_sh, map_sh),
+        in_shardings=in_sh,
         out_shardings=(repl, repl),
         donate_argnums=(0,))
 
@@ -356,7 +444,7 @@ def make_device_step_body(model, tx, cfg: Config, target: int):
     mean = jnp.asarray(mean)
     std = jnp.asarray(std)
 
-    def step(state: TrainState, key, step_idx, images, boxes, labels, valid):
+    def prep(key, step_idx, images, boxes, labels, valid):
         # per-step randomness derived INSIDE the program: the host passes
         # the constant base key + a scalar step index instead of folding on
         # the host (which would dispatch an extra device op per step)
@@ -371,14 +459,42 @@ def make_device_step_body(model, tx, cfg: Config, target: int):
             translate_percent=cfg.translate_percent,
             affine_scale=tuple(cfg.affine_scale))
         img = (img / 255.0 - mean) / std
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (_, (batch_stats, losses)), grads = grad_fn(
-            state.params, state.batch_stats, model, img, heat, off, wh, mask,
-            cfg)
-        new_state = _optimizer_update(state, tx, cfg, grads, batch_stats)
-        return new_state, _maybe_telemetry(cfg, losses, grads, state.params,
-                                           new_state)
+        return img, heat, off, wh, mask
 
+    if not getattr(cfg, "sentinel", False):
+        def step(state: TrainState, key, step_idx, images, boxes, labels,
+                 valid):
+            img, heat, off, wh, mask = prep(key, step_idx, images, boxes,
+                                            labels, valid)
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            (_, (batch_stats, losses)), grads = grad_fn(
+                state.params, state.batch_stats, model, img, heat, off, wh,
+                mask, cfg)
+            new_state = _optimizer_update(state, tx, cfg, grads, batch_stats)
+            return new_state, _maybe_telemetry(cfg, losses, grads,
+                                               state.params, new_state)
+
+        step.sentinel = False
+        return step
+
+    def step(state: TrainState, key, step_idx, images, boxes, labels,
+             valid, loss_scale):
+        img, heat, off, wh, mask = prep(key, step_idx, images, boxes,
+                                        labels, valid)
+
+        def scaled_loss(params, batch_stats):
+            total, aux = loss_fn(params, batch_stats, model, img, heat,
+                                 off, wh, mask, cfg)
+            return total * loss_scale, aux
+
+        grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
+        (_, (batch_stats, losses)), grads = grad_fn(state.params,
+                                                    state.batch_stats)
+        grads = jax.tree.map(lambda g: g / loss_scale, grads)
+        return _sentinel_update(cfg, state, tx, grads, batch_stats, losses,
+                                loss_scale)
+
+    step.sentinel = True
     return step
 
 
@@ -393,9 +509,10 @@ def make_device_train_step(model, tx, cfg: Config, mesh, target: int):
     img_sh = batch_sharding(mesh, 4)     # gather-based warp: no spatial shard
     box_sh = batch_sharding(mesh, 3)
     lab_sh = batch_sharding(mesh, 2)
-    return jax.jit(step,
-                   in_shardings=(repl, repl, repl, img_sh, box_sh, lab_sh,
-                                 lab_sh),
+    in_sh = (repl, repl, repl, img_sh, box_sh, lab_sh, lab_sh)
+    if getattr(step, "sentinel", False):
+        in_sh = in_sh + (repl,)
+    return jax.jit(step, in_shardings=in_sh,
                    out_shardings=(repl, repl), donate_argnums=(0,))
 
 
@@ -410,25 +527,28 @@ def make_cached_device_train_step(model, tx, cfg: Config, mesh, target: int,
     ~B*canvas^2*3 raw pixels of the streaming path — the input pipeline
     cannot be the bottleneck at any batch size."""
     body = make_device_step_body(model, tx, cfg, target)
+    sentinel = getattr(body, "sentinel", False)
 
     def step(state: TrainState, key, step_idx, images_all, boxes_all,
-             labels_all, valid_all, idx):
+             labels_all, valid_all, idx, *scale):
         gather = lambda a: jnp.take(a, idx, axis=0)  # noqa: E731
         return body(state, key, step_idx, gather(images_all),
                     gather(boxes_all), gather(labels_all),
-                    gather(valid_all))
+                    gather(valid_all), *scale)
 
     repl = replicated(mesh)
     idx_sh = batch_sharding(mesh, 1)
-    jitted = jax.jit(step,
-                     in_shardings=(repl, repl, repl, repl, repl, repl, repl,
-                                   idx_sh),
+    in_sh = (repl, repl, repl, repl, repl, repl, repl, idx_sh)
+    if sentinel:
+        in_sh = in_sh + (repl,)
+    jitted = jax.jit(step, in_shardings=in_sh,
                      out_shardings=(repl, repl), donate_argnums=(0,))
 
-    def run(state, key, step_idx, idx):
+    def run(state, key, step_idx, idx, *scale):
         return jitted(state, key, step_idx, cache.images, cache.boxes,
-                      cache.labels, cache.valid, idx)
+                      cache.labels, cache.valid, idx, *scale)
 
+    run.sentinel = sentinel
     return run
 
 
@@ -741,9 +861,16 @@ def make_snapshot_fn(model, cfg: Config):
     return snapshot
 
 
-def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
+def make_step_runner(cfg: Config, mesh, model, tx, cache=None,
+                     sentinel_scale=None):
     """Build `runner(state, batch, step_idx) -> (state, losses)` for the
     configured input path.
+
+    `sentinel_scale` (`--sentinel` runs): a zero-arg callable returning
+    the current loss scale (SentinelMonitor.scale_value — the host-side
+    backoff lever); the runner forwards it as the jitted step's trailing
+    f32 argument each call. A scalar H2D rides the dispatch args — no
+    extra round trip, no recompile (same aval every call).
 
     Host path: targets encoded in collate; runner shards the 5 arrays and
     calls the plain train step. Device path (`--device-augment`): runner
@@ -761,6 +888,14 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
     """
     from .data import StagedBatch
 
+    sentinel = bool(getattr(cfg, "sentinel", False))
+    scale_of = sentinel_scale if sentinel_scale is not None else (lambda: 1.0)
+
+    def scale_args():
+        # () when the sentinel is off: the call (and the traced program)
+        # is exactly the pre-PR one
+        return (np.float32(scale_of()),) if sentinel else ()
+
     if not cfg.device_augment:
         step = make_train_step(model, tx, cfg, mesh)
 
@@ -772,7 +907,7 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
         def runner(state, batch, step_idx):
             arrays = (batch.arrays if isinstance(batch, StagedBatch)
                       else stage(batch))
-            return step(state, *arrays)
+            return step(state, *arrays, *scale_args())
 
         runner.stage = stage
         return runner
@@ -832,12 +967,12 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
         def runner(state, idx_batch, step_idx):
             return get_step(pick_target(step_idx))(
                 state, base_key, np.int32(step_idx),
-                np.asarray(idx_batch, np.int32))
+                np.asarray(idx_batch, np.int32), *scale_args())
 
         runner.prewarm = lambda state: prewarm(
             state, lambda st, target: get_step(target)(
                 st, base_key, np.int32(0),
-                np.zeros((cfg.batch_size,), np.int32)))
+                np.zeros((cfg.batch_size,), np.int32), *scale_args()))
         runner.steps = steps  # bucket -> jitted step (tests assert coverage)
         return runner
 
@@ -857,7 +992,7 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
         images, boxes, labels, valid = arrays
         return get_step(pick_target(step_idx))(
             state, base_key, np.int32(step_idx), images, boxes, labels,
-            valid)
+            valid, *scale_args())
 
     def _dummy_call(st, target):
         canvas = cfg.multiscale[1]
@@ -868,7 +1003,7 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None):
                  np.zeros((local_b, cfg.max_boxes), bool))
         images, boxes, labels, valid = shard_batch(mesh, dummy)
         return get_step(target)(st, base_key, np.int32(0), images, boxes,
-                                labels, valid)
+                                labels, valid, *scale_args())
 
     runner.prewarm = lambda state: prewarm(state, _dummy_call)
     runner.steps = steps  # bucket -> jitted step (tests assert coverage)
@@ -902,13 +1037,109 @@ class FaultInjector:
                 % (epoch, i))
 
 
+class SentinelMonitor:
+    """Host half of the `--sentinel` self-healing loop (ISSUE 9).
+
+    The jitted step already did the time-critical part (skip-step: a
+    tripped step leaves the TrainState untouched); this monitor reads the
+    sentinel scalars OFF the existing deferred loss fetch — so its
+    decisions have the flush interval's latency, and cost zero extra D2H
+    — and plays the two slower recovery cards:
+
+    * **loss-scale backoff**: after a flush window containing skipped
+      steps, the scale the runner feeds the step is multiplied by
+      `cfg.sentinel_backoff` (floor 1/1024); each clean window doubles it
+      back toward 1.0. The loss is scaled before backward and the grads
+      unscaled after, so a transient bf16 overflow stops tripping without
+      changing the converged optimum.
+    * **rollback escalation**: `cfg.sentinel_divergence` CONSECUTIVE
+      skipped steps mean the blowup is not transient — skipping forever
+      would silently stall training — so observe() raises
+      `TrainingDivergenceError` and train() restores the last good
+      checkpoint (budget: `cfg.sentinel_rollbacks`).
+
+    Every decision is flight-recorder evidence (`recover:skip-step` /
+    `recover:backoff` / `recover:rollback` events) for obs_report's
+    Faults section. No reference analogue (the reference has no numeric
+    failure handling at all, ref train.py:86-162)."""
+
+    MIN_SCALE = 1.0 / 1024.0
+
+    def __init__(self, cfg: Config, tracer=None):
+        self.cfg = cfg
+        self._tracer = tracer
+        self.scale = 1.0
+        self.skipped = 0
+        self.consecutive_bad = 0
+        self.rollbacks = 0
+
+    def scale_value(self) -> float:
+        """The runner's per-call loss-scale source (make_step_runner)."""
+        return self.scale
+
+    def observe(self, fetched) -> None:
+        """Consume one flush window of ALREADY-FETCHED loss dicts (host
+        scalars — never device arrays: this must not hide a D2H). Raises
+        TrainingDivergenceError on sustained divergence."""
+        window_bad = 0
+        diverged = False
+        for rec in fetched:
+            if float(rec.get("sentinel_bad", 0.0)) > 0.5:
+                window_bad += 1
+                self.skipped += 1
+                self.consecutive_bad += 1
+                if self.consecutive_bad >= self.cfg.sentinel_divergence:
+                    diverged = True
+            else:
+                self.consecutive_bad = 0
+        if window_bad:
+            if self._tracer is not None:
+                self._tracer.event("recover:skip-step", n=window_bad,
+                                   total=self.skipped)
+            new_scale = max(self.MIN_SCALE,
+                            self.scale * self.cfg.sentinel_backoff)
+            if new_scale != self.scale:
+                if self._tracer is not None:
+                    self._tracer.event("recover:backoff", scale=new_scale)
+                self.scale = new_scale
+        elif self.scale < 1.0:
+            self.scale = min(1.0, self.scale * 2.0)
+        if diverged:
+            raise TrainingDivergenceError(
+                "sentinel: %d consecutive skipped steps (>= "
+                "--sentinel-divergence %d) — sustained numeric divergence"
+                % (self.consecutive_bad, self.cfg.sentinel_divergence))
+
+    def note_rollback(self) -> None:
+        """A checkpoint rollback happened: the restored state predates the
+        blowup, so the backoff (aimed at the diverged trajectory) resets
+        with it."""
+        self.rollbacks += 1
+        self.consecutive_bad = 0
+        self.scale = 1.0
+
+
+def _poison_batch(batch):
+    """Apply a chaos `nan-batch` fault to a host batch (tests/chaos only;
+    never on the production path). Poisons the first float field so the
+    forward pass — and therefore the in-jit sentinel — sees it."""
+    import dataclasses
+    for field in ("image", "heatmap", "boxes"):
+        arr = getattr(batch, field, None)
+        if isinstance(arr, np.ndarray) and arr.dtype.kind == "f":
+            return dataclasses.replace(
+                batch, **{field: np.full_like(arr, np.nan)})
+    return batch  # staged/uint8 wires: nothing poisonable host-side
+
+
 def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
                 state: TrainState, mesh, loss_log: LossLog,
                 is_chief: bool = True, snapshot_fn=None,
                 profile_this_epoch: bool = False,
                 epoch_base_step: int = 0, watchdog=None,
                 injector: Optional[FaultInjector] = None,
-                tracer=None) -> TrainState:
+                tracer=None, monitor: Optional[SentinelMonitor] = None,
+                chaos=None) -> TrainState:
     """One epoch of the hot loop (≡ ref train.py:86-162 `train_step`).
 
     `tracer` (obs/spans.py, optional): when span tracing is enabled the
@@ -916,7 +1147,14 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
     batch production), `step` (async dispatch + any un-hidden device
     wait), `fetch` (the deferred loss flush, i.e. the real completion
     barrier) and `h2d` (the prefetcher's sharded device_put) — so a slow
-    epoch is attributable after the fact instead of folklore."""
+    epoch is attributable after the fact instead of folklore.
+
+    `monitor` (`--sentinel`): consumes each flush window's fetched
+    sentinel scalars (same D2H as the losses) for skip accounting,
+    loss-scale backoff and the divergence escalation. `chaos`
+    (runtime.faults.ChaosInjector, tests only): fires the `train:batch`
+    site per iteration — a `nan-batch` event poisons the host batch so
+    the in-jit sentinel path is exercisable deterministically."""
     from .obs.spans import SpanTracer
     if tracer is None:
         tracer = SpanTracer(None)  # disabled: wrap() is identity
@@ -948,6 +1186,10 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
         for fetched in fetched_all:
             loss_log.append(fetched)
         pending.clear()
+        if monitor is not None:
+            # the sentinel scalars rode the SAME fetch; observe() may
+            # raise TrainingDivergenceError -> train()'s rollback branch
+            monitor.observe(fetched_all)
 
     iterator = loader
     if cfg.device_prefetch > 0 and hasattr(step_runner, "stage"):
@@ -963,6 +1205,11 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
     for i, batch in enumerate(iterator):
         if injector is not None:
             injector.maybe_fire(epoch, i)
+        if chaos is not None:
+            ev = chaos.fire("train:batch", epoch=epoch, it=i)
+            if ev is not None and ev.kind == "nan-batch" \
+                    and not isinstance(batch, StagedBatch):
+                batch = _poison_batch(batch)
         data_t = time.time() - tic
         meters["data"].update(data_t)
         if tracer.enabled:
@@ -1025,9 +1272,13 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
     return state
 
 
-def train(cfg: Config) -> TrainState:
+def train(cfg: Config, chaos=None) -> TrainState:
     """Full training driver (≡ ref train.py:23-83
-    `distributed_device_train` + `distributed_worker`)."""
+    `distributed_device_train` + `distributed_worker`).
+
+    `chaos` (runtime.faults.ChaosInjector; tests/chaos suite only): fault
+    events replayed into the epoch loop so the `--sentinel` recovery
+    paths are exercised deterministically on CPU."""
     init_distributed(cfg)
     ndev = cfg.num_devices or len(jax.devices())
     if ndev % cfg.spatial:
@@ -1074,13 +1325,17 @@ def train(cfg: Config) -> TrainState:
         loader = cache
     else:
         loader_cls = BatchLoader
+        loader_extra = {}
         if cfg.loader == "process":
             # GIL-free host pipeline: spawned worker processes + shared-
             # memory batch transport (data/shm_pool.py); bit-identical to
             # the thread loader, with an automatic in-process fallback if
-            # a worker dies
+            # a worker dies. --sentinel additionally arms the poison-batch
+            # quarantine: a produced batch carrying non-finite values is
+            # dropped (and counted) instead of reaching the step.
             from .data import ProcessBatchLoader
             loader_cls = ProcessBatchLoader
+            loader_extra = {"quarantine": cfg.sentinel}
         loader = loader_cls(
             dataset, augmentor,
             batch_size=cfg.batch_size // jax.process_count(),
@@ -1090,7 +1345,7 @@ def train(cfg: Config) -> TrainState:
             max_boxes=cfg.max_boxes, shuffle=True, drop_last=True,
             rank=jax.process_index(), world_size=jax.process_count(),
             seed=cfg.random_seed, num_workers=cfg.num_workers,
-            raw=cfg.device_augment)
+            raw=cfg.device_augment, **loader_extra)
     steps_per_epoch = max(1, len(loader))
 
     dtype = jnp.bfloat16 if cfg.amp else None
@@ -1109,7 +1364,13 @@ def train(cfg: Config) -> TrainState:
             print("%s: resumed from %s (epoch %d)"
                   % (timestamp(), cfg.model_load, ckpt_epoch), flush=True)
 
-    runner = make_step_runner(cfg, mesh, model, tx, cache=cache)
+    # --sentinel: the monitor is the host half of the self-healing loop;
+    # the runner reads its loss scale per call (tracer attached below,
+    # once the flight recorder exists)
+    monitor = SentinelMonitor(cfg) if cfg.sentinel else None
+    runner = make_step_runner(
+        cfg, mesh, model, tx, cache=cache,
+        sentinel_scale=monitor.scale_value if monitor else None)
     if cfg.prewarm:
         if hasattr(runner, "prewarm"):
             if is_chief:
@@ -1163,6 +1424,8 @@ def train(cfg: Config) -> TrainState:
     # epoch slow" answerable when a shape change silently retraced.
     from .obs.spans import maybe_tracer
     tracer = maybe_tracer(cfg.span_log or None)
+    if monitor is not None and tracer.enabled:
+        monitor._tracer = tracer  # recover:* events join the span log
     recompiles = None
     if tracer.enabled:
         from .obs.telemetry import install_recompile_counter
@@ -1195,7 +1458,8 @@ def train(cfg: Config) -> TrainState:
                     loss_log, is_chief, snapshot_fn,
                     profile_this_epoch=(cfg.profile and epoch == start_epoch),
                     epoch_base_step=epoch * steps_per_epoch,
-                    watchdog=watchdog, injector=injector, tracer=tracer)
+                    watchdog=watchdog, injector=injector, tracer=tracer,
+                    monitor=monitor, chaos=chaos)
                 if epoch_flush is not None and int(jax.device_get(
                         state.opt_state.mini_step)):
                     # partial accumulation window at epoch end: flush it
@@ -1245,6 +1509,29 @@ def train(cfg: Config) -> TrainState:
                                                       rm_err), flush=True)
                             del run_ckpts[:-n_keep]
                     watchdog.resume("epoch %d checkpoint done" % epoch)
+            except TrainingDivergenceError as e:
+                # Sentinel rollback (ISSUE 9): sustained numeric divergence
+                # — the device is HEALTHY (no probe, no backoff, no cache
+                # clear, runner/compiled steps stay valid); restore the
+                # last good checkpoint and rerun from its epoch. The rerun
+                # is deterministic (batch content is a pure function of
+                # (seed, epoch, batch_idx)), so absent further faults it
+                # matches a clean resume bit-for-bit (chaos-suite pinned).
+                if not (monitor is not None and run_ckpts
+                        and monitor.rollbacks < cfg.sentinel_rollbacks):
+                    raise
+                monitor.note_rollback()
+                latest = run_ckpts[-1]
+                state, ckpt_epoch, loss_log = load_checkpoint(latest, state)
+                epoch = ckpt_epoch + 1
+                tracer.event("recover:rollback", checkpoint=latest,
+                             epoch=epoch, attempt=monitor.rollbacks)
+                print("%s: sentinel divergence (%s); rollback %d/%d to %s "
+                      "(epoch %d)"
+                      % (timestamp(), str(e).splitlines()[0][:160],
+                         monitor.rollbacks, cfg.sentinel_rollbacks, latest,
+                         ckpt_epoch), flush=True)
+                continue
             except Exception as e:  # noqa: BLE001 — filtered just below
                 # Elastic recovery (--auto-resume N; the reference's only
                 # recovery is a manual restart with --model-load, ref
@@ -1307,7 +1594,9 @@ def train(cfg: Config) -> TrainState:
                             drop_last=True, seed=cfg.random_seed,
                             num_workers=cfg.num_workers, mesh=mesh)
                         loader = cache
-                runner = make_step_runner(cfg, mesh, model, tx, cache=cache)
+                runner = make_step_runner(
+                    cfg, mesh, model, tx, cache=cache,
+                    sentinel_scale=monitor.scale_value if monitor else None)
                 # only checkpoints written by THIS run are trusted: a
                 # reused save_path can hold a previous run's (possibly
                 # later-epoch) checkpoints, which would silently replace

@@ -169,6 +169,34 @@ AST_FIXTURES = {
         '"""A cited module (ref train.py:86) with provenance."""\n'
         "X = 1\n",
     ),
+    "unbounded-retry": (
+        # the r2 probe-kill class: swallow, loop again, forever, no pause
+        "import jax\n"
+        "def wait_for_claim():\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return jax.devices()\n"
+        "        except Exception:\n"
+        "            continue\n",
+        # bounded attempts + backoff (and a consumer loop stays exempt)
+        "import queue, time, jax\n"
+        "def wait_for_claim():\n"
+        "    for attempt in range(5):\n"
+        "        try:\n"
+        "            return jax.devices()\n"
+        "        except Exception:\n"
+        "            time.sleep(2.0 * (attempt + 1))\n"
+        "    raise RuntimeError('claim never cleared')\n"
+        "def consume(q):\n"
+        "    while True:\n"
+        "        task = q.get()\n"
+        "        if task is None:\n"
+        "            break\n"
+        "        try:\n"
+        "            task()\n"
+        "        except Exception:\n"
+        "            continue\n",
+    ),
     "raw-span-timing": (
         # a chip-path script (acquires a backend) timing a span by hand
         "import time\n"

@@ -175,6 +175,52 @@ def summarize_serving(paths: List[str]) -> Optional[Dict]:
     return out
 
 
+def summarize_faults(paths: List[str]) -> Optional[Dict]:
+    """The Faults section (ISSUE 9): join `fault:*` injection events
+    against the `recover:*` evidence of what healed (requeues, retries
+    exhausted, skip-steps, backoffs, rollbacks, quarantines, reloads) and
+    the engine's `serve:state` transitions — a post-mortem reads what was
+    injected (or actually failed) next to what the self-healing layers
+    did about it. Returns None when the round recorded no fault
+    activity."""
+    injected: Dict[str, int] = {}
+    by_site: Dict[str, int] = {}
+    recoveries: Dict[str, int] = {}
+    requeued = exhausted = skipped = 0
+    transitions: Dict[str, int] = {}
+    for path in paths:
+        for rec in read_spans(path):
+            name = rec.get("name", "")
+            meta = rec.get("meta") or {}
+            if name.startswith("fault:"):
+                kind = name[len("fault:"):]
+                injected[kind] = injected.get(kind, 0) + 1
+                site = meta.get("site", "?")
+                by_site[site] = by_site.get(site, 0) + 1
+            elif name.startswith("recover:"):
+                what = name[len("recover:"):]
+                recoveries[what] = recoveries.get(what, 0) + 1
+                n = meta.get("n")
+                if isinstance(n, int):
+                    if what == "requeue":
+                        requeued += n
+                    elif what == "retry-exhausted":
+                        exhausted += n
+                    elif what == "skip-step":
+                        skipped += n
+            elif name == "serve:state":
+                arc = "%s->%s" % (meta.get("from", "?"), meta.get("to", "?"))
+                transitions[arc] = transitions.get(arc, 0) + 1
+    if not (injected or recoveries or transitions):
+        return None
+    return {"injected": injected, "injected_total": sum(injected.values()),
+            "by_site": by_site, "recoveries": recoveries,
+            "requeued_requests": requeued,
+            "retry_exhausted_requests": exhausted,
+            "skipped_steps": skipped,
+            "engine_transitions": transitions}
+
+
 def summarize_queue(queue_dir: Optional[str]) -> Optional[Dict]:
     """Read-only tolerant replay of the job journal: per-job final state,
     attempts, salvage evidence, queued->terminal wall seconds."""
@@ -285,6 +331,7 @@ def build_report(round_name: str, span_paths: List[str],
         "schema": SCHEMA, "tool": "obs_report", "round": round_name,
         "spans": summarize_spans(span_paths),
         "serving": summarize_serving(span_paths),
+        "faults": summarize_faults(span_paths),
         "queue": summarize_queue(queue_dir),
         "bench": summarize_bench(bench_paths),
         "loss": summarize_loss_log(loss_paths),
@@ -350,6 +397,30 @@ def render_markdown(rep: Dict) -> str:
                                 s["p99_ms"], s["max_ms"]))
     else:
         lines.append("_no serving activity recorded_")
+    lines += [""]
+    flt = rep.get("faults")
+    lines += ["## Faults", ""]
+    if flt:
+        lines += ["Injected: %s (by site: %s)"
+                  % ((", ".join("%s ×%d" % (k, v) for k, v
+                                in sorted(flt["injected"].items()))
+                      or "none"),
+                     (", ".join("%s ×%d" % (k, v) for k, v
+                                in sorted(flt["by_site"].items()))
+                      or "-")), "",
+                  "Healed: %s" % (", ".join(
+                      "%s ×%d" % (k, v) for k, v
+                      in sorted(flt["recoveries"].items())) or "none"), "",
+                  "Requests requeued: %d, retry-exhausted: %d; train "
+                  "steps skipped: %d" % (flt["requeued_requests"],
+                                         flt["retry_exhausted_requests"],
+                                         flt["skipped_steps"])]
+        if flt["engine_transitions"]:
+            lines += ["", "Engine state transitions: " + ", ".join(
+                "%s ×%d" % (k, v) for k, v
+                in sorted(flt["engine_transitions"].items()))]
+    else:
+        lines.append("_no fault/recovery activity recorded_")
     lines += [""]
     q = rep["queue"]
     lines += ["## Queue", ""]
@@ -453,6 +524,22 @@ def selfcheck() -> int:
             tracer.record("serve:compute", 0.0005, b=2)
             tracer.record("serve:d2h", 0.008, b=2, n=2)
         tracer.event("serve:shed", reason="queue-full")
+        # fault/recovery taxonomy (ISSUE 9): injections + what healed —
+        # the Faults section's joins
+        tracer.event("fault:device-loss", site="serve:dispatch", at=3,
+                     seq=1)
+        tracer.event("fault:nan-batch", site="train:batch", at=5, seq=2)
+        tracer.event("recover:requeue", stage="dispatch", b=2, n=2,
+                     error="InjectedBackendError")
+        tracer.event("recover:retry-exhausted", stage="dispatch", n=1,
+                     error="InjectedBackendError")
+        tracer.event("recover:skip-step", n=1, total=1)
+        tracer.event("recover:rollback", checkpoint="ck", epoch=1,
+                     attempt=1)
+        tracer.event("serve:state", **{"from": "serving",
+                                       "to": "degraded"})
+        with tracer.span("recover:reload"):
+            pass
         tracer.close()
         with open(span_path, "a") as f:  # graftlint: off=raw-artifact-write
             f.write('{"kind": "span", "torn')  # kill -9 mid-append twin
@@ -507,8 +594,9 @@ def selfcheck() -> int:
         check("schema tagged", rep["schema"] == SCHEMA)
         sp = rep["spans"]
         check("torn span tail dropped, all real records read",
-              sp["records"] == 25)  # meta + 4 steps + ckpt + hb + ctx
-        # + 16 serve spans + shed event
+              sp["records"] == 33)  # meta + 4 steps + ckpt + hb + ctx
+        # + 16 serve spans + shed event + 7 fault/recover events +
+        # reload span
         check("step span stats", sp["by_name"].get("step", {}).get(
             "count") == 4 and abs(sp["by_name"]["step"]["total_s"]
                                   - 0.1) < 1e-6)
@@ -527,6 +615,20 @@ def selfcheck() -> int:
         check("serving stage digests + fill",
               set(srv["stages"]) == {"batch-form", "h2d", "compute", "d2h"}
               and srv["mean_batch_fill"] == 2.0)
+        flt = rep["faults"]
+        check("faults section joined", flt is not None
+              and flt["injected"] == {"device-loss": 1, "nan-batch": 1}
+              and flt["by_site"] == {"serve:dispatch": 1,
+                                     "train:batch": 1})
+        check("recovery evidence joined",
+              flt["recoveries"].get("requeue") == 1
+              and flt["recoveries"].get("reload") == 1
+              and flt["recoveries"].get("rollback") == 1
+              and flt["requeued_requests"] == 2
+              and flt["retry_exhausted_requests"] == 1
+              and flt["skipped_steps"] == 1)
+        check("engine transitions joined",
+              flt["engine_transitions"] == {"serving->degraded": 1})
         q = rep["queue"]
         check("queue states joined", q is not None
               and q["jobs"]["bench"]["state"] == "done"
@@ -549,6 +651,10 @@ def selfcheck() -> int:
         check("markdown carries queue table", "| bench | done |" in md)
         check("markdown carries serving section",
               "## Serving" in md and "e2e latency: p50 30.000 ms" in md)
+        check("markdown carries faults section",
+              "## Faults" in md and "device-loss ×1" in md
+              and "rollback ×1" in md
+              and "serving->degraded ×1" in md)
 
     ok = not failures
     print(json.dumps({"tool": "obs_report", "selfcheck": True, "ok": ok,

@@ -26,11 +26,20 @@ timing claim; bench.py owns those (scanned programs, dispatch-overhead
 subtraction). On the remote-tunnel backend wall clocks are still honest
 for END-TO-END request latency because the result fetch is a real D2H.
 
+* **fault scenario mode** (`--faults`, ISSUE 9) — replay a seeded,
+  deterministic fault schedule (runtime/faults.py: device-loss, hung
+  fetch, slow batch) at the engine's dispatch/fetch sites DURING the
+  open-loop run: the curve then reports goodput/p99 under injected
+  failure, plus `lost` per row (acknowledged requests that surfaced an
+  error) and a `faults` object (what was injected, what the engine
+  retried/requeued). The selfcheck pins `lost == 0` under the canned
+  schedule — in-flight recovery keeps every acknowledged request.
+
 Artifact: `artifacts/<round>/serving/serve_bench.json`, schema
 **serve-bench-v1**, atomic write; ONE JSON line on stdout (repo
 convention). `--selfcheck` proves the engine contract (bit-identity vs
-one-shot predict, shed paths, zero recompiles) on seeded CPU load in
-~a minute.
+one-shot predict, shed paths, zero recompiles, zero lost acks under
+faults) on seeded CPU load in ~a minute.
 """
 
 from __future__ import annotations
@@ -50,7 +59,8 @@ sys.path.insert(0, REPO)
 
 from bench import acquire_backend, graft_round  # noqa: E402
 from real_time_helmet_detection_tpu.runtime import (  # noqa: E402
-    maybe_job_heartbeat, run_as_job)
+    ChaosInjector, FaultSchedule, maybe_injector, maybe_job_heartbeat,
+    run_as_job)
 from real_time_helmet_detection_tpu.serving import SheddedError  # noqa: E402
 from real_time_helmet_detection_tpu.utils import save_json  # noqa: E402
 
@@ -139,7 +149,10 @@ def open_loop(engine, pool: List[np.ndarray], schedule: List[float],
               duration_s: float, deadline_s: float,
               offered_rps: float) -> Dict:
     """Poisson arrivals with deadlines; goodput = on-time completions/s.
-    Sheds (admission control) are counted, never retried."""
+    Sheds (admission control) are counted, never retried. `lost` counts
+    ACKNOWLEDGED (admitted, non-shed) requests that surfaced an error —
+    the quantity the chaos selfcheck pins at ZERO under fault injection
+    (the engine's bounded retries absorb every scheduled fault)."""
     futs = []
     t0 = time.monotonic()
     for i, at in enumerate(schedule):
@@ -150,12 +163,15 @@ def open_loop(engine, pool: List[np.ndarray], schedule: List[float],
                                   deadline_s=deadline_s, block=False))
     # grace: whatever was admitted near the horizon may still complete
     deadline_wall = time.monotonic() + deadline_s + 2.0
-    ontime, late, shed, lats = 0, 0, 0, []
+    ontime, late, shed, lost, lats = 0, 0, 0, 0, []
     for fut in futs:
         try:
             fut.result(timeout=max(0.1, deadline_wall - time.monotonic()))
-        except Exception:  # noqa: BLE001 — shed / closed / timed out
+        except SheddedError:
             shed += 1
+            continue
+        except Exception:  # noqa: BLE001 — retry-exhausted / closed /
+            lost += 1      # timed out: an acknowledged request was LOST
             continue
         lat = fut.t_done - fut.t_submit
         lats.append(lat)
@@ -166,7 +182,8 @@ def open_loop(engine, pool: List[np.ndarray], schedule: List[float],
     return {"mode": "open", "offered_rps": round(offered_rps, 2),
             "duration_s": round(duration_s, 2), "n": len(schedule),
             "completed": ontime + late, "ontime": ontime, "late": late,
-            "shed": shed, "deadline_ms": round(deadline_s * 1e3, 1),
+            "shed": shed, "lost": lost,
+            "deadline_ms": round(deadline_s * 1e3, 1),
             "goodput_rps": round(ontime / duration_s, 2), **_lat_ms(lats)}
 
 
@@ -283,11 +300,25 @@ def run_bench(args) -> Dict:
     log("serial b1 capacity: %.1f req/s" % serial_rps)
     HB.beat("serial capacity measured")
 
+    # --faults: deterministic chaos replay (ISSUE 9) — the seeded schedule
+    # fires at the engine's serve:dispatch / serve:fetch sites while the
+    # SAME load loops run, so the curve shows goodput/p99 UNDER injected
+    # device-loss and hangs, and `lost` proves recovery kept every
+    # acknowledged request
+    injector = maybe_injector(args.faults, tracer=tracer)
+    if injector is not None:
+        out["faults_spec"] = injector.schedule.spec()
+        log("fault injection armed: %s" % out["faults_spec"])
     engine = ServingEngine(predict, variables,
                            (args.imsize, args.imsize, 3), np.uint8,
                            buckets=args.buckets,
                            max_wait_ms=args.max_wait_ms, depth=args.depth,
-                           queue_capacity=args.queue_cap, tracer=tracer)
+                           queue_capacity=args.queue_cap, tracer=tracer,
+                           max_retries=args.max_retries,
+                           hang_timeout_s=(args.hang_timeout_ms / 1e3
+                                           if args.hang_timeout_ms > 0
+                                           else None),
+                           injector=injector)
     try:
         # closed loop: engine saturation capacity
         warm = engine.predict_many(pool[:min(4, len(pool))])
@@ -319,6 +350,20 @@ def run_bench(args) -> Dict:
                    row["p99_ms"], row["shed"]))
             HB.beat("open loop x%.2f done" % mult)
         out["curve"] = curve
+        if injector is not None:
+            st = engine.stats()
+            out["faults"] = {
+                "spec": injector.schedule.spec(),
+                "injected": injector.summary(),
+                "retried": st["retried"],
+                "requeued_batches": st["requeued_batches"],
+                "hung_batches": st["hung_batches"],
+                "lost_acks": sum(r.get("lost", 0) for r in curve),
+                "engine_state": engine.state,
+            }
+            log("faults: injected %d, retried %d, lost acks %d"
+                % (out["faults"]["injected"]["total"],
+                   out["faults"]["retried"], out["faults"]["lost_acks"]))
     finally:
         engine.close()
 
@@ -461,9 +506,48 @@ def selfcheck() -> int:
                         offered_rps=60.0)
         engine3.close()
         check("open loop completes its schedule",
-              row["completed"] + row["shed"] == row["n"]
-              and row["completed"] > 0)
+              row["completed"] + row["shed"] + row["lost"] == row["n"]
+              and row["completed"] > 0 and row["lost"] == 0)
         check("p50 <= p99", (row["p50_ms"] or 0) <= (row["p99_ms"] or 0))
+
+        # fault scenario mode (ISSUE 9): the canned schedule injects a
+        # device-loss at dispatch and a hung fetch mid-stream; bounded
+        # retries must keep ZERO acknowledged requests lost and every
+        # survivor bit-identical to its one-shot predict
+        canned = ("serve:dispatch=device-loss@2,"
+                  "serve:fetch=hung-fetch@4,"
+                  "serve:dispatch=device-loss@6")
+        inj = ChaosInjector(FaultSchedule.parse(canned))
+        eng4 = ServingEngine(predict, variables, (64, 64, 3), np.uint8,
+                             buckets=(1, 2, 4), max_wait_ms=2.0, depth=2,
+                             queue_capacity=64,
+                             max_retries=3, hang_timeout_s=0.1,
+                             injector=inj)
+        futs4 = [(int(i), eng4.submit(pool[int(i)]))
+                 for i in np.random.default_rng(5).integers(0, len(pool),
+                                                            24)]
+        rows4 = []
+        lost4 = 0
+        for i, f in futs4:
+            try:
+                rows4.append((i, f.result(timeout=60)))
+            except Exception:  # noqa: BLE001 — would be a lost ack
+                lost4 += 1
+        st4 = eng4.stats()
+        eng4.close()
+        check("faults: all scheduled events fired",
+              len(inj.fired) == 3 and inj.pending() == 0)
+        check("faults: zero lost acknowledged requests",
+              lost4 == 0 and st4["failed"] == 0
+              and st4["completed"] == len(futs4))
+        check("faults: retried results bit-identical to one-shot",
+              all(np.array_equal(getattr(row, name),
+                                 getattr(oracle[i], name))
+                  for i, row in rows4
+                  for name in ("boxes", "classes", "scores", "valid")))
+        check("faults: recovery accounted",
+              st4["retried"] >= 1 and st4["requeued_batches"] >= 2
+              and st4["hung_batches"] == 1)
         art = os.path.join(tmp, "serve_bench.json")
         save_json(art, {"schema": SCHEMA, "curve": [row]}, indent=1)
         with open(art) as f:
@@ -528,6 +612,19 @@ def main(argv=None) -> int:
     p.add_argument("--pool", type=int, default=32,
                    help="distinct request images")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--faults", default="",
+                   help="deterministic fault schedule replayed during the "
+                        "load run (ISSUE 9): 'site=kind@n,...' (e.g. "
+                        "'serve:dispatch=device-loss@9') or the seeded "
+                        "shorthand 'seed=<int>[,n=<int>]'; the JSON line "
+                        "gains a faults object and per-row lost counts")
+    p.add_argument("--max-retries", type=int, default=2,
+                   help="engine per-request retry budget after a batch "
+                        "failure/hang")
+    p.add_argument("--hang-timeout-ms", type=float, default=0.0,
+                   help="engine fetch watchdog (0 disables; defaults to "
+                        "500 when --faults is set so injected hangs are "
+                        "detected instead of waited out)")
     p.add_argument("--span-log", default="",
                    help="flight-recorder span log (else $OBS_SPAN_LOG)")
     p.add_argument("--out", default=None,
@@ -547,6 +644,8 @@ def main(argv=None) -> int:
     args.amp = (not on_cpu) if args.amp is None else args.amp
     args.infer_dtype = args.infer_dtype or ("bf16" if on_cpu else "int8")
     args.buckets = tuple(sorted(set(args.buckets)))
+    if args.faults and args.hang_timeout_ms <= 0:
+        args.hang_timeout_ms = 500.0
 
     out = run_bench(args)
     path = args.out or os.path.join(REPO, "artifacts", graft_round(),

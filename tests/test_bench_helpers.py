@@ -426,3 +426,27 @@ def test_find_last_tpu_result_old_lines_lack_serve_keys(tmp_path):
         "platform": "tpu", "metric": "inference_fps_512", "value": 1100.0})
     got = bench.find_last_tpu_result(root)
     assert "serve_p50_ms" not in got and "serve_goodput" not in got
+
+
+def test_find_last_tpu_result_carries_sentinel_fields(tmp_path):
+    """ISSUE 9 satellite: the JSON line's sentinel (on/off) and
+    skipped_steps keys survive find_last_tpu_result; the pre-existing
+    consumer contract is untouched."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r11", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1250.0,
+        "mfu_train": 0.61, "sentinel": "on", "skipped_steps": 0})
+    got = bench.find_last_tpu_result(root)
+    assert got["sentinel"] == "on"
+    assert got["skipped_steps"] == 0
+    assert got["value"] == 1250.0 and got["mfu_train"] == 0.61
+
+
+def test_find_last_tpu_result_old_lines_lack_sentinel_keys(tmp_path):
+    """A pre-sentinel artifact resolves exactly as before."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r10", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1100.0})
+    got = bench.find_last_tpu_result(root)
+    assert "sentinel" not in got and "skipped_steps" not in got
+    assert got["value"] == 1100.0

@@ -199,3 +199,14 @@ def test_serve_flags_parse_and_validate():
         Config(serve_depth=0)
     with pytest.raises(ValueError, match="serve-queue"):
         Config(serve_queue=0)
+    # ISSUE 9: the in-flight recovery knobs
+    cfg = parse_args(["--serve-max-retries", "4",
+                      "--serve-hang-timeout-ms", "750"])
+    assert cfg.serve_max_retries == 4
+    assert cfg.serve_hang_timeout_ms == 750.0
+    assert parse_args([]).serve_max_retries == 2
+    assert parse_args([]).serve_hang_timeout_ms == 0.0  # watchdog off
+    with pytest.raises(ValueError, match="serve-max-retries"):
+        Config(serve_max_retries=-1)
+    with pytest.raises(ValueError, match="serve-hang-timeout-ms"):
+        Config(serve_hang_timeout_ms=-5.0)

@@ -81,6 +81,24 @@ AST_CASES = [
     ("ast/missing-ref-citation", "scripts/x.py",
      '"""Module with no provenance statement whatsoever."""\nX = 1\n',
      '"""Module citing ref evaluate.py:15 properly."""\nX = 1\n'),
+    ("ast/unbounded-retry", "scripts/x.py",
+     # the r2 probe-kill class: swallow + loop forever, no cap, no pause
+     "import jax\n"
+     "def wait():\n"
+     "    while True:\n"
+     "        try:\n"
+     "            return jax.devices()\n"
+     "        except Exception:\n"
+     "            continue\n",
+     # bounded + backed-off retry
+     "import time, jax\n"
+     "def wait():\n"
+     "    for attempt in range(5):\n"
+     "        try:\n"
+     "            return jax.devices()\n"
+     "        except Exception:\n"
+     "            time.sleep(2.0 * (attempt + 1))\n"
+     "    raise RuntimeError('never came up')\n"),
 ]
 
 
@@ -99,6 +117,55 @@ def test_queue_bypass_scoped_to_chip_scripts():
         ast_rules.lint_source(src, "scripts/x.py"))
     assert "ast/queue-bypass" not in rules_of(
         ast_rules.lint_source(src, "real_time_helmet_detection_tpu/x.py"))
+
+
+def test_unbounded_retry_exemptions():
+    """The rule must NOT flag the legitimate while-True shapes the repo
+    runs on: queue-consumer loops (the serving dispatcher/fetcher, the
+    shm worker — they block on `.get()` and re-attempt on NEW work) and
+    backed-off reconnect loops; a handler that re-raises is bounded."""
+    consumer = ("def loop(q):\n"
+                "    while True:\n"
+                "        task = q.get()\n"
+                "        if task is None:\n"
+                "            break\n"
+                "        try:\n"
+                "            task()\n"
+                "        except Exception:\n"
+                "            continue\n")
+    backed_off = ("import time\n"
+                  "def loop(connect):\n"
+                  "    while True:\n"
+                  "        try:\n"
+                  "            return connect()\n"
+                  "        except Exception:\n"
+                  "            time.sleep(5.0)\n")
+    reraises = ("def loop(connect):\n"
+                "    while True:\n"
+                "        try:\n"
+                "            return connect()\n"
+                "        except Exception:\n"
+                "            raise\n")
+    for src in (consumer, backed_off, reraises):
+        assert "ast/unbounded-retry" not in rules_of(
+            ast_rules.lint_source(src, "scripts/x.py")), src
+    # and an inline suppression silences a justified exception
+    bad = ("def loop(connect):\n"
+           "    while True:\n"
+           "        try:\n"
+           "            return connect()\n"
+           "        except Exception:  # graftlint: off=unbounded-retry\n"
+           "            continue\n")
+    assert "ast/unbounded-retry" not in rules_of(
+        ast_rules.lint_source(bad, "scripts/x.py"))
+
+
+def test_unbounded_retry_repo_is_clean():
+    """The production tree at HEAD carries zero unbounded retry loops —
+    fixed, not grandfathered (the baseline stays EMPTY)."""
+    findings = [f for f in ast_rules.lint_repo(REPO)
+                if f.rule == "ast/unbounded-retry"]
+    assert findings == []
 
 
 def test_inline_suppression_and_syntax_error():

@@ -1,0 +1,342 @@
+"""Sentinel self-healing tests (ISSUE 9): the in-jit NaN/Inf + grad-spike
+skip-step, the zero-extra-D2H contract, sentinel-off bit-identity to the
+pre-PR step, the scanned skip counter, and the host-side monitor's
+backoff/divergence ladder.
+
+The reference has no numeric failure handling of any kind (a NaN batch
+silently poisons its run, ref train.py:86-162); everything here guards
+new capability. Fetch counting follows tests/test_obs.py: jax's transfer
+guards never fire on CPU, so the D2H contract is pinned by counting
+`jax.device_get` calls.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from real_time_helmet_detection_tpu.config import Config
+from real_time_helmet_detection_tpu.models import build_model
+from real_time_helmet_detection_tpu.optim import build_optimizer
+from real_time_helmet_detection_tpu.runtime import TrainingDivergenceError
+from real_time_helmet_detection_tpu.train import (SentinelMonitor,
+                                                  _optimizer_update,
+                                                  create_train_state,
+                                                  loss_fn,
+                                                  make_scanned_train_fn,
+                                                  make_train_step,
+                                                  make_train_step_body)
+
+IMSIZE = 64
+
+
+def tiny_cfg(**kw):
+    base = dict(num_stack=1, hourglass_inch=16, num_cls=2, batch_size=4,
+                lr=1e-3)
+    base.update(kw)
+    return Config(**base)
+
+
+def synthetic_batch(b=4, seed=0):
+    from real_time_helmet_detection_tpu.data import synthetic_target_batch
+    return synthetic_target_batch(b, IMSIZE, seed=seed)
+
+
+def make_state(cfg):
+    model = build_model(cfg)
+    tx = build_optimizer(cfg, steps_per_epoch=10)
+    state = create_train_state(model, cfg, jax.random.key(0), IMSIZE, tx)
+    return model, tx, state
+
+
+def _clone(state):
+    return jax.tree.map(lambda x: jnp.array(np.asarray(x)), state)
+
+
+def _poisoned(arrs):
+    return (jnp.full_like(arrs[0], jnp.nan),) + arrs[1:]
+
+
+# ---------------------------------------------------------------------------
+# the in-jit skip-step
+
+
+def test_sentinel_skips_nan_batch_and_preserves_state_bitwise():
+    """Acceptance: a NaN batch trips the sentinel and the WHOLE TrainState
+    (params, optimizer moments, batch stats, step counter) keeps its
+    pre-step bytes — one poison batch cannot contaminate the run."""
+    cfg = tiny_cfg(sentinel=True)
+    model, tx, state = make_state(cfg)
+    body = make_train_step_body(model, tx, cfg)
+    arrs = tuple(jnp.asarray(a) for a in synthetic_batch())
+    st, losses = jax.jit(body)(state, *_poisoned(arrs), jnp.float32(1.0))
+    losses = jax.device_get(losses)
+    assert losses["sentinel_bad"] == 1.0
+    assert not np.isfinite(losses["total"])
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(st)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+    # and a clean batch through the SAME program advances normally
+    st2, losses2 = jax.jit(body)(state, *arrs, jnp.float32(1.0))
+    losses2 = jax.device_get(losses2)
+    assert losses2["sentinel_bad"] == 0.0
+    assert int(st2.step) == int(state.step) + 1
+    assert np.isfinite(losses2["sentinel_grad_norm"])
+
+
+def test_sentinel_spike_threshold_trips_on_finite_grads():
+    """--sentinel-spike: a finite step whose global grad norm exceeds the
+    threshold is skipped too (the grad-norm-spike half of the check)."""
+    cfg = tiny_cfg(sentinel=True, sentinel_spike=1e-6)  # everything spikes
+    model, tx, state = make_state(cfg)
+    body = make_train_step_body(model, tx, cfg)
+    arrs = tuple(jnp.asarray(a) for a in synthetic_batch())
+    st, losses = jax.jit(body)(state, *arrs, jnp.float32(1.0))
+    losses = jax.device_get(losses)
+    assert np.isfinite(losses["total"])          # the batch is healthy...
+    assert losses["sentinel_bad"] == 1.0         # ...but the spike trips
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(st.params)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_sentinel_off_bit_identical_to_pre_pr():
+    """Acceptance: sentinel off traces the exact pre-PR program — loss
+    and updated params BIT-identical to the pre-PR body reimplemented
+    verbatim (the test_obs.py twin pattern)."""
+    cfg = tiny_cfg()  # sentinel=False
+    model, tx, state = make_state(cfg)
+    body = make_train_step_body(model, tx, cfg)
+
+    def pre_pr_body(state, images, gt_heat, gt_off, gt_wh, mask):
+        # the pre-ISSUE-9 make_train_step_body, verbatim
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (_, (batch_stats, losses)), grads = grad_fn(
+            state.params, state.batch_stats, model, images, gt_heat,
+            gt_off, gt_wh, mask, cfg)
+        new_state = _optimizer_update(state, tx, cfg, grads, batch_stats)
+        return new_state, losses
+
+    arrs = tuple(jnp.asarray(a) for a in synthetic_batch(seed=11))
+    sa, la = jax.jit(body)(_clone(state), *arrs)
+    sb, lb = jax.jit(pre_pr_body)(_clone(state), *arrs)
+    la, lb = jax.device_get((la, lb))
+    assert set(la) == set(lb)  # no sentinel keys leak in when off
+    for k in lb:
+        assert np.asarray(la[k]).tobytes() == np.asarray(lb[k]).tobytes()
+    for x, y in zip(jax.tree.leaves(sa.params), jax.tree.leaves(sb.params)):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_sentinel_zero_extra_d2h(monkeypatch):
+    """Acceptance: the sentinel scalars ride the SAME deferred flush —
+    the train_epoch-style loop performs exactly as many device_get calls
+    with the sentinel on as off, and the monitor consumes already-host
+    scalars without any further device access."""
+    n_steps = 4
+
+    def run_loop(cfg):
+        model, tx, state = make_state(cfg)
+        from real_time_helmet_detection_tpu.parallel import (make_mesh,
+                                                             shard_batch)
+        mesh = make_mesh(1)
+        step = make_train_step(model, tx, cfg, mesh)
+        batch = shard_batch(mesh, synthetic_batch(), spatial_dims=[1] * 5)
+        monitor = SentinelMonitor(cfg) if cfg.sentinel else None
+        calls = []
+        real_get = jax.device_get
+
+        def counting(tree):
+            calls.append(tree)
+            return real_get(tree)
+
+        monkeypatch.setattr(jax, "device_get", counting)
+        pending = []
+        for _ in range(n_steps):
+            args = (np.float32(monitor.scale_value()),) if monitor else ()
+            state, losses = step(state, *batch, *args)
+            pending.append(losses)
+        fetched = jax.device_get(pending)  # THE one flush D2H
+        if monitor is not None:
+            monitor.observe(fetched)
+        n = len(calls)
+        monkeypatch.undo()
+        return n, monitor
+
+    on_calls, monitor = run_loop(tiny_cfg(sentinel=True))
+    off_calls, _ = run_loop(tiny_cfg())
+    assert on_calls == off_calls == 1
+    assert monitor.skipped == 0  # clean batches: nothing skipped
+
+
+# ---------------------------------------------------------------------------
+# the scanned path (bench.py's wire)
+
+
+def test_scanned_sentinel_counts_skips_and_rides_the_fetch():
+    cfg = tiny_cfg(sentinel=True)
+    model, tx, state = make_state(cfg)
+    body = make_train_step_body(model, tx, cfg)
+    arrs = tuple(jnp.asarray(a) for a in synthetic_batch())
+    scan = make_scanned_train_fn(body, 3, sentinel=True)
+    compiled = jax.jit(scan, donate_argnums=(0,))
+    st, (last, skipped) = compiled(_clone(state), *arrs)
+    assert int(jax.device_get(skipped)) == 0
+    st, (last, skipped) = compiled(_clone(state), *_poisoned(arrs))
+    last, skipped = jax.device_get((last, skipped))
+    assert int(skipped) == 3 and not np.isfinite(last)
+
+
+def test_scanned_sentinel_requires_sentinel_body():
+    cfg = tiny_cfg()  # sentinel OFF body
+    model, tx, state = make_state(cfg)
+    body = make_train_step_body(model, tx, cfg)
+    with pytest.raises(ValueError, match="cfg.sentinel=True"):
+        make_scanned_train_fn(body, 2, sentinel=True)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        make_scanned_train_fn(body, 2, sentinel=True, telemetry=True)
+
+
+def test_scanned_sentinel_donation_emits_no_warning():
+    """The sentinel scan must keep the donation contract: every donated
+    state buffer has a same-aval output to alias (the where-select's
+    output), no 'donated buffers were not usable' warning."""
+    import warnings
+    cfg = tiny_cfg(sentinel=True)
+    model, tx, state = make_state(cfg)
+    body = make_train_step_body(model, tx, cfg)
+    arrs = tuple(jnp.asarray(a) for a in synthetic_batch())
+    scan = make_scanned_train_fn(body, 2, sentinel=True)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        compiled = jax.jit(scan, donate_argnums=(0,)).lower(
+            state, *arrs).compile()
+        st, (last, skipped) = compiled(_clone(state), *arrs)
+        np.asarray(last)
+    bad = [w for w in caught if "donated buffers" in str(w.message)]
+    assert not bad, [str(w.message) for w in bad]
+
+
+# ---------------------------------------------------------------------------
+# sentinel + telemetry compose in the per-step path
+
+
+def test_sentinel_composes_with_telemetry_scalars():
+    cfg = tiny_cfg(sentinel=True, telemetry=True)
+    model, tx, state = make_state(cfg)
+    body = make_train_step_body(model, tx, cfg)
+    arrs = tuple(jnp.asarray(a) for a in synthetic_batch())
+    _, losses = jax.jit(body)(state, *arrs, jnp.float32(1.0))
+    losses = jax.device_get(losses)
+    for k in ("grad_norm", "update_norm", "param_norm", "sentinel_bad",
+              "sentinel_grad_norm", "sentinel_scale"):
+        assert k in losses
+
+
+# ---------------------------------------------------------------------------
+# the host-side monitor
+
+
+def _mk_monitor(**kw):
+    cfg = tiny_cfg(sentinel=True, **kw)
+    return SentinelMonitor(cfg)
+
+
+def test_monitor_backoff_and_recovery_ladder():
+    mon = _mk_monitor(sentinel_backoff=0.5, sentinel_divergence=10)
+    mon.observe([{"sentinel_bad": 1.0}, {"sentinel_bad": 0.0}])
+    assert mon.scale == 0.5 and mon.skipped == 1
+    mon.observe([{"sentinel_bad": 1.0}, {"sentinel_bad": 0.0}])
+    assert mon.scale == 0.25
+    mon.observe([{"sentinel_bad": 0.0}] * 4)   # clean window: recover x2
+    assert mon.scale == 0.5
+    mon.observe([{"sentinel_bad": 0.0}])
+    assert mon.scale == 1.0
+    mon.observe([{"sentinel_bad": 0.0}])       # capped at 1.0
+    assert mon.scale == 1.0
+
+
+def test_monitor_scale_floor():
+    mon = _mk_monitor(sentinel_backoff=0.5, sentinel_divergence=1000)
+    for _ in range(30):
+        mon.observe([{"sentinel_bad": 1.0}, {"sentinel_bad": 0.0}])
+    assert mon.scale == SentinelMonitor.MIN_SCALE
+
+
+def test_monitor_divergence_needs_consecutive_bad():
+    mon = _mk_monitor(sentinel_divergence=3)
+    # interleaved good steps reset the consecutive counter: no escalation
+    mon.observe([{"sentinel_bad": 1.0}, {"sentinel_bad": 1.0},
+                 {"sentinel_bad": 0.0}, {"sentinel_bad": 1.0}])
+    assert mon.consecutive_bad == 1
+    with pytest.raises(TrainingDivergenceError, match="consecutive"):
+        mon.observe([{"sentinel_bad": 1.0}, {"sentinel_bad": 1.0}])
+    # rollback resets the ladder
+    mon.note_rollback()
+    assert mon.rollbacks == 1 and mon.consecutive_bad == 0
+    assert mon.scale == 1.0
+
+
+def test_monitor_divergence_not_a_transient_backend_error():
+    """The rollback path must NOT be eaten by --auto-resume's transient
+    classifier: the device is healthy, a backend re-init would not help."""
+    from real_time_helmet_detection_tpu.runtime import \
+        is_transient_backend_error
+    assert not is_transient_backend_error(TrainingDivergenceError("x"))
+
+
+# ---------------------------------------------------------------------------
+# device-augment path plumbing
+
+
+def test_device_augment_sentinel_step_runs_and_skips():
+    from real_time_helmet_detection_tpu.parallel import (make_mesh,
+                                                         shard_batch)
+    from real_time_helmet_detection_tpu.train import make_device_train_step
+    cfg = tiny_cfg(sentinel=True, sentinel_spike=1e-6,
+                   device_augment=True, multiscale=[64, 64, 64])
+    model, tx, state = make_state(cfg)
+    mesh = make_mesh(1)
+    step = make_device_train_step(model, tx, cfg, mesh, target=64)
+    rng = np.random.default_rng(0)
+    b = cfg.batch_size
+    dummy = (rng.integers(0, 255, (b, 64, 64, 3)).astype(np.uint8),
+             np.zeros((b, cfg.max_boxes, 4), np.float32),
+             np.zeros((b, cfg.max_boxes), np.int32),
+             np.zeros((b, cfg.max_boxes), bool))
+    images, boxes, labels, valid = shard_batch(mesh, dummy)
+    key = jax.device_put(jax.random.key(3))
+    st, losses = step(_clone(state), key, np.int32(0), images, boxes,
+                      labels, valid, np.float32(1.0))
+    losses = jax.device_get(losses)
+    # the 1e-6 spike threshold trips on any real gradient: step skipped
+    assert losses["sentinel_bad"] == 1.0
+    for a, b2 in zip(jax.tree.leaves(state.params),
+                     jax.tree.leaves(st.params)):
+        assert np.asarray(a).tobytes() == np.asarray(b2).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# config surface
+
+
+def test_sentinel_flags_parse_and_validate():
+    from real_time_helmet_detection_tpu.config import parse_args
+    cfg = parse_args(["--sentinel", "--sentinel-spike", "100.0",
+                      "--sentinel-backoff", "0.25",
+                      "--sentinel-divergence", "5",
+                      "--sentinel-rollbacks", "1"])
+    assert cfg.sentinel and cfg.sentinel_spike == 100.0
+    assert cfg.sentinel_backoff == 0.25
+    assert cfg.sentinel_divergence == 5 and cfg.sentinel_rollbacks == 1
+    assert not Config().sentinel  # off by default: pre-PR program
+    with pytest.raises(ValueError):
+        Config(sentinel_backoff=0.0)
+    with pytest.raises(ValueError):
+        Config(sentinel_backoff=1.5)
+    with pytest.raises(ValueError):
+        Config(sentinel_divergence=0)
+    with pytest.raises(ValueError):
+        Config(sentinel_rollbacks=-1)
+    with pytest.raises(ValueError):
+        Config(sentinel_spike=-1.0)

@@ -21,9 +21,11 @@ from real_time_helmet_detection_tpu.config import Config  # noqa: E402
 from real_time_helmet_detection_tpu.models import build_model  # noqa: E402
 from real_time_helmet_detection_tpu.predict import \
     make_predict_fn  # noqa: E402
+from real_time_helmet_detection_tpu.runtime import (  # noqa: E402
+    ChaosInjector, FaultEvent, FaultSchedule)
 from real_time_helmet_detection_tpu.serving import (  # noqa: E402
-    DEFAULT_BUCKETS, EngineClosedError, ServingEngine, SheddedError,
-    resolve_buckets)
+    DEFAULT_BUCKETS, DEGRADED, SERVING, EngineClosedError, FetchHungError,
+    ServingEngine, SheddedError, resolve_buckets)
 from real_time_helmet_detection_tpu.train import init_variables  # noqa: E402
 
 IMSIZE = 64
@@ -203,6 +205,155 @@ def test_resolve_buckets_contract():
         Config(serve_buckets=[0, 2])
     with pytest.raises(ValueError):
         Config(serve_buckets=[])
+
+
+def test_injected_dispatch_fault_retries_bit_identical(parts):
+    """ISSUE 9 in-flight recovery: an injected device-loss at dispatch
+    requeues the batch's requests; the retry reuses the SAME AOT
+    executable, so results stay bit-identical to one-shot predict and
+    zero acknowledged requests are lost."""
+    _, predict, variables, pool, oracle = parts
+    inj = ChaosInjector(FaultSchedule.parse("serve:dispatch=device-loss@2"))
+    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
+                        buckets=BUCKETS, max_wait_ms=1.0, depth=2,
+                        queue_capacity=32, max_retries=2, injector=inj)
+    futs = [(i, eng.submit(pool[i])) for i in range(6)]
+    rows = [(i, f.result(timeout=60)) for i, f in futs]
+    st = eng.stats()
+    health = eng.health()
+    eng.close()
+    assert all(_rows_equal(r, oracle[i]) for i, r in rows)
+    assert len(inj.fired) == 1 and inj.fired[0].kind == "device-loss"
+    assert st["failed"] == 0 and st["completed"] == 6
+    assert st["retried"] >= 1 and st["requeued_batches"] == 1
+    assert health["stats"]["failed_batches"] == 1
+
+
+def test_hung_fetch_watchdog_requeues(parts):
+    """An injected hung fetch (sleep past the watchdog) is detected, the
+    batch requeued, and the retried requests complete bit-identically —
+    the r7 tunnel-hang signature as a tested code path."""
+    _, predict, variables, pool, oracle = parts
+    # hang_s must exceed the watchdog for the timeout to fire
+    inj = ChaosInjector(FaultSchedule([
+        FaultEvent("serve:fetch", "hung-fetch", 1, {"hang_s": 1.0})]))
+    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
+                        buckets=BUCKETS, max_wait_ms=1.0, depth=2,
+                        queue_capacity=32, max_retries=2,
+                        hang_timeout_s=0.15, injector=inj)
+    futs = [(i, eng.submit(pool[i])) for i in range(3)]
+    rows = [(i, f.result(timeout=60)) for i, f in futs]
+    st = eng.stats()
+    eng.close()
+    assert all(_rows_equal(r, oracle[i]) for i, r in rows)
+    assert st["hung_batches"] == 1
+    assert st["failed"] == 0 and st["completed"] == 3
+
+
+def test_retry_budget_exhaustion_surfaces_error(parts):
+    """Budget exhausted => the error surfaces on the future (never a
+    silent loss), and the lost request is accounted in stats."""
+    _, predict, variables, pool, _ = parts
+    spec = ",".join("serve:dispatch=device-loss@%d" % n for n in (1, 2, 3))
+    inj = ChaosInjector(FaultSchedule.parse(spec))
+    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
+                        buckets=(1,), max_wait_ms=0.0, depth=1,
+                        queue_capacity=8, max_retries=2, injector=inj)
+    fut = eng.submit(pool[0])
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        fut.result(timeout=60)
+    st = eng.stats()
+    eng.close()
+    assert st["failed"] == 1 and st["retried"] == 2
+
+
+def test_state_machine_degraded_and_recovery(parts):
+    """SERVING -> DEGRADED on a batch failure, back to SERVING after
+    `recover_after` consecutive healthy batches; health() snapshots it."""
+    _, predict, variables, pool, _ = parts
+    inj = ChaosInjector(FaultSchedule.parse("serve:dispatch=device-loss@1"))
+    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
+                        buckets=(1,), max_wait_ms=0.0, depth=1,
+                        queue_capacity=8, max_retries=1, recover_after=2,
+                        injector=inj)
+    assert eng.state == SERVING
+    eng.submit(pool[0]).result(timeout=60)  # fault -> retry succeeds
+    assert eng.state == DEGRADED  # one healthy batch < recover_after
+    eng.submit(pool[1]).result(timeout=60)
+    assert eng.drain(10.0)
+    assert eng.state == SERVING
+    h = eng.health()
+    eng.close()
+    assert h["state"] == SERVING and h["consecutive_failures"] == 0
+    assert h["queued"] == 0 and h["inflight_batches"] == 0
+    assert h["stats"]["failed_batches"] == 1
+    assert eng.health()["state"] == "closed"
+
+
+def test_hot_reload_swaps_weights_without_dropping(parts):
+    """Graceful drain + hot reload: requests before the swap match the
+    old-weight oracle, requests after match the NEW weights' one-shot
+    predict, zero recompiles, zero dropped requests."""
+    from real_time_helmet_detection_tpu.obs.telemetry import \
+        install_recompile_counter
+    _, predict, variables, pool, oracle = parts
+    # a distinct checkpoint: perturb one conv kernel
+    new_vars = jax.tree.map(lambda x: x, variables)
+    new_vars = jax.device_get(new_vars)
+    leaves, treedef = jax.tree.flatten(new_vars)
+    leaves = [np.asarray(x) for x in leaves]
+    leaves[0] = leaves[0] + 0.25
+    new_vars = jax.tree.unflatten(treedef, leaves)
+    pending = [predict(new_vars, img[None]) for img in pool[:4]]
+    new_oracle = [type(d)(*(np.asarray(leaf[0]) for leaf in d))
+                  for d in jax.device_get(pending)]
+
+    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
+                        buckets=BUCKETS, max_wait_ms=1.0, depth=2,
+                        queue_capacity=32)
+    before = [(i, eng.submit(pool[i])) for i in range(4)]
+    counter = install_recompile_counter()
+    eng.reload(new_vars, timeout_s=30.0)
+    after = [(i, eng.submit(pool[i])) for i in range(4)]
+    rows_before = [(i, f.result(timeout=60)) for i, f in before]
+    rows_after = [(i, f.result(timeout=60)) for i, f in after]
+    st = eng.stats()
+    eng.close()
+    assert counter.count == 0  # the swap never recompiles a bucket
+    assert all(_rows_equal(r, oracle[i]) for i, r in rows_before)
+    assert all(_rows_equal(r, new_oracle[i]) for i, r in rows_after)
+    assert any(not _rows_equal(a, b) for (_, a), (_, b)
+               in zip(rows_before, rows_after))  # the swap actually took
+    assert st["reloads"] == 1 and st["failed"] == 0
+    assert st["completed"] == 8
+
+
+def test_recovery_spans_land_in_flight_recorder(parts, tmp_path):
+    """fault:* injections and recover:* evidence are joined later by
+    obs_report; the engine must emit them ($OBS_SPAN_LOG contract)."""
+    from real_time_helmet_detection_tpu.obs.spans import (maybe_tracer,
+                                                          read_spans)
+    _, predict, variables, pool, _ = parts
+    path = str(tmp_path / "chaos_spans.jsonl")
+    tracer = maybe_tracer(path)
+    inj = ChaosInjector(FaultSchedule.parse(
+        "serve:dispatch=device-loss@1,serve:dispatch=device-loss@2"),
+        tracer=tracer)
+    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
+                        buckets=(1,), max_wait_ms=0.0, depth=1,
+                        queue_capacity=8, max_retries=1, tracer=tracer,
+                        injector=inj)
+    with pytest.raises(RuntimeError):
+        eng.submit(pool[0]).result(timeout=60)
+    eng.close()
+    tracer.close()
+    recs = read_spans(path)
+    names = [r.get("name") for r in recs]
+    assert names.count("fault:device-loss") == 2
+    assert names.count("recover:requeue") == 2
+    assert "recover:retry-exhausted" in names
+    states = [r["meta"] for r in recs if r.get("name") == "serve:state"]
+    assert {"from": "serving", "to": "degraded"} in states
 
 
 def test_results_in_submission_order_across_batches(parts):

@@ -173,6 +173,109 @@ def test_process_loader_no_shm_leak_subprocess(voc_root, tmp_path):
     assert not glob.glob("/dev/shm/helmet_shm_*")
 
 
+class _PoisonAugmentor(TrainAugmentor):
+    """Augmentor that emits NaN canvases for ONE batch — float blowup
+    after the uint8 decode stage, the corruption class the ISSUE-9
+    quarantine exists for. The batch is identified via the per-batch
+    reseed entropy (`seed_augmentor_for_batch` sets rng from
+    SeedSequence((seed, epoch, batch_idx))), so the poison is
+    deterministic across worker processes AND the thread fallback."""
+
+    def __init__(self, poison_batch, **kw):
+        super().__init__(**kw)
+        self.poison_batch = int(poison_batch)
+
+    def _coords(self):
+        try:
+            ent = self.rng.bit_generator.seed_seq.entropy
+        except AttributeError:
+            return None
+        return tuple(ent) if isinstance(ent, (tuple, list)) else None
+
+    def __call__(self, images, boxes, labels):
+        images, boxes, labels = super().__call__(images, boxes, labels)
+        coords = self._coords()
+        if coords and len(coords) == 3 and coords[2] == self.poison_batch:
+            images = [np.full(np.asarray(im).shape, np.nan, np.float32)
+                      for im in images]
+        return images, boxes, labels
+
+
+def _quarantine_loader(root, poison_batch=None, quarantine=True):
+    ds = VOCDataset(root, "trainval")
+    kw = dict(multiscale_flag=True, multiscale=[32, 64, 16],
+              rng=np.random.default_rng(9))
+    aug = (TrainAugmentor(**kw) if poison_batch is None
+           else _PoisonAugmentor(poison_batch, **kw))
+    return ProcessBatchLoader(ds, aug, batch_size=3, num_workers=2,
+                              prefetch=2, seed=5, shuffle=False,
+                              drop_last=False, max_boxes=8,
+                              quarantine=quarantine)
+
+
+def test_quarantine_drops_poisoned_batch(voc_root):
+    """ISSUE 9: a batch carrying non-finite floats never reaches the
+    consumer; the rest of the epoch is untouched and the drop is
+    counted + visible in worker_status."""
+    loader = _quarantine_loader(voc_root, poison_batch=0)
+    try:
+        batches = list(loader)
+        assert loader.quarantined == 1
+        # shuffle=False: sample 0 lives in batch 0; the others survive
+        assert len(batches) == len(loader) - 1
+        for b in batches:
+            for f in _BULK_FIELDS:
+                arr = getattr(b, f)
+                if arr.dtype.kind == "f":
+                    assert np.isfinite(arr).all(), f
+        assert "quarantined:1" in loader.worker_status()
+    finally:
+        loader.close()
+
+
+def test_quarantine_off_passes_poison_through(voc_root):
+    """Off by default: the pre-PR behavior (and its zero scan cost) is
+    preserved — the poison flows through untouched."""
+    loader = _quarantine_loader(voc_root, poison_batch=0, quarantine=False)
+    try:
+        batches = list(loader)
+        assert loader.quarantined == 0
+        assert len(batches) == len(loader)
+        assert not np.isfinite(batches[0].image).all()
+    finally:
+        loader.close()
+
+
+def test_quarantine_clean_run_identical_to_unquarantined(voc_root):
+    """With healthy data the quarantine scan must change nothing: same
+    batches, bit-identical (the injection-disabled twin)."""
+    a = _quarantine_loader(voc_root, quarantine=True)
+    b = _quarantine_loader(voc_root, quarantine=False)
+    try:
+        batches_a = list(a)
+        batches_b = list(b)
+        assert a.quarantined == 0
+        assert len(batches_a) == len(batches_b)
+        for x, y in zip(batches_a, batches_b):
+            _assert_batches_equal(x, y)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_quarantine_applies_in_thread_fallback(voc_root):
+    """The fallback path (dead worker -> in-process production) keeps the
+    quarantine: the recovery path must not reopen the poison hole."""
+    loader = _quarantine_loader(voc_root, poison_batch=0)
+    try:
+        loader._fell_back = True  # force the thread path from the start
+        batches = list(loader)
+        assert loader.quarantined == 1
+        assert len(batches) == len(loader) - 1
+    finally:
+        loader.close()
+
+
 def test_device_prefetcher_order_and_staging():
     """DevicePrefetcher yields every item, in order, wrapped as
     StagedBatch, and calls stage() ahead of consumption (depth)."""
