@@ -79,11 +79,11 @@ DEFAULT_HBM = 819e9  # v5e
 # and runner_drive.py (they diverged in r5: mfu_breakdown defaulted to r05
 # while the rest stayed at r04, scattering same-round artifacts — ADVICE
 # r5 #3); bump it here when a new round starts, or override per-run with
-# $GRAFT_ROUND. r11 = the chaos/self-healing round (ISSUE 9: fault
-# injection, serving in-flight recovery, train sentinel); earlier
+# $GRAFT_ROUND. r12 = the live-metrics round (ISSUE 10: obs.metrics
+# plane, SLO watchdog, scripts/perfgate.py regression gate); earlier
 # rounds' artifact dirs are committed history and must not be
 # overwritten.
-GRAFT_ROUND_DEFAULT = "r11"
+GRAFT_ROUND_DEFAULT = "r12"
 
 # v5e int8 MXU peak (2x the bf16 peak — jax-ml scaling-book): the
 # denominator for int8-path MFU and the hardware case for --infer-dtype
@@ -241,7 +241,7 @@ def find_last_tpu_result(repo_root: str | None = None) -> dict | None:
             "peak_xla_us", "pallas_matches_xla", "infer_dtype", "int8_fps",
             "int8_vs_bf16", "recompile_count", "loadavg", "param_policy",
             "epilogue", "serve_p50_ms", "serve_p99_ms", "serve_goodput",
-            "sentinel", "skipped_steps")
+            "sentinel", "skipped_steps", "step_p50_ms", "step_p99_ms")
     out.update({k: rec[k] for k in keep if k in rec})
     return out
 
@@ -321,6 +321,30 @@ def chain_timed_fetch(compiled, variables, images, overhead: float,
     return max(best - overhead, 1e-9)
 
 
+def chained_scan_step_samples(compiled, state, args, overhead: float,
+                              chunks: int = 3):
+    """`timed_fetch` for the state-donating scanned train program, run
+    `chunks` times CHAINED: each dispatch's returned final state (same
+    avals/shardings as the donated input — the scan's aliasing contract)
+    becomes the next dispatch's input, so repeats never touch a
+    donated-away buffer, and each dispatch fetches ONLY the scalar tail.
+
+    Returns (per-dispatch wall seconds, final state). The primary step
+    time stays best-of (min — `timed_fetch`'s semantics, now over
+    `chunks` real dispatches instead of one); the per-dispatch spread is
+    what feeds the `bench.step_ms` histogram behind the JSON line's
+    step_p50_ms/step_p99_ms (ISSUE 10). Same methodology as everything
+    here: scanned program, scalar fetch, measured overhead subtracted."""
+    import jax
+    samples = []
+    for _ in range(max(1, int(chunks))):
+        t0 = time.perf_counter()
+        state, tail = compiled(state, *args)
+        jax.tree.map(np.asarray, tail)  # scalar fetch: forces completion
+        samples.append(max(time.perf_counter() - t0 - overhead, 1e-9))
+    return samples, state
+
+
 def main() -> None:
     """Wrapper keeping the ONE-JSON-line contract even on failure: a
     backend death (or any crash) still prints the line, with
@@ -376,11 +400,16 @@ def _bench(out: dict, hb) -> None:
     # always, and the host-context sample whose loadavg rides the JSON
     # line — cross-run wall-clock deltas finally carry their confounders
     # (this box's speed varies ~2x over hours, CLAUDE.md).
+    from real_time_helmet_detection_tpu.obs.metrics import maybe_writer
     from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
     from real_time_helmet_detection_tpu.obs.telemetry import \
         install_recompile_counter
     tracer = maybe_tracer()
     recompiles = install_recompile_counter(tracer)
+    # live metrics plane (ISSUE 10): the step-time histogram behind
+    # step_p50_ms/step_p99_ms always counts in memory; $OBS_METRICS arms
+    # the crash-safe snapshot export next to the span log
+    mwriter = maybe_writer()
     ctx = tracer.context(phase="bench", platform=platform)
     out["loadavg"] = ctx.get("loadavg")
     out["span_log"] = tracer.path
@@ -390,6 +419,7 @@ def _bench(out: dict, hb) -> None:
     def _finalize_obs() -> None:
         """Late fields for the ONE JSON line (both print sites)."""
         out["recompile_count"] = recompiles.count
+        mwriter.close()  # final metrics snapshot (when $OBS_METRICS)
 
     peak = DEFAULT_PEAK
     peak_known = False
@@ -668,11 +698,24 @@ def _bench(out: dict, hb) -> None:
             np.asarray(tcompiled(state, *arrs)[1])
             out["skipped_steps"] = 0
         state = create_train_state(tmodel, tcfg, jax.random.key(0), imsize, tx)
-        dt = timed_fetch(lambda *a: tcompiled(*a)[1], (state, *arrs),
-                         overhead, repeats=1)
+        # three CHAINED timed dispatches of the same compiled scan (state
+        # threads through donation): min is the primary step time
+        # (timed_fetch best-of semantics), the spread feeds the metrics
+        # histogram behind step_p50_ms/step_p99_ms (ISSUE 10)
+        samples, _ = chained_scan_step_samples(tcompiled, state, arrs,
+                                               overhead, chunks=3)
+        dt = min(samples)
         out["train_img_per_sec_chip"] = round(train_batch * n_train / dt, 2)
         out["train_batch"] = train_batch
         out["train_step_ms"] = round(dt / n_train * 1e3, 3)
+        from real_time_helmet_detection_tpu.obs.metrics import \
+            default_registry
+        step_hist = default_registry().histogram("bench.step_ms")
+        for s in samples:
+            step_hist.observe(s / n_train * 1e3)
+        p50, p99 = step_hist.quantile(0.50), step_hist.quantile(0.99)
+        out["step_p50_ms"] = None if p50 is None else round(p50, 3)
+        out["step_p99_ms"] = None if p99 is None else round(p99, 3)
         if train_flops:
             # scan body counted once by cost analysis -> multiply by n_train
             out["mfu_train"] = round(train_flops * n_train / dt / peak, 4)

@@ -53,6 +53,17 @@ analogue is manual code review, ref /root/reference/README.md:1):
                           amortize. Results must ride the per-BATCH D2H
                           (`ServingEngine._fetch_loop`, the allowlisted
                           completion point).
+* `raw-metric-aggregation` — hand-rolled running-mean/percentile
+                          arithmetic (np.percentile/median/quantile
+                          calls, or the sorted-then-rank-index idiom) in
+                          a chip-path script: ad-hoc statistics keep
+                          re-growing incompatible latency digests that
+                          neither merge nor export — route them through
+                          `obs.metrics` (fixed-layout mergeable
+                          histograms whose snapshots the SLO watchdog
+                          and perfgate consume). The sanctioned bench
+                          timing harness (median-of-dispatch-overheads)
+                          is allowlisted.
 * `unbounded-retry`     — a `while True` retry loop whose except handler
                           swallows the failure and loops again with no
                           attempt cap and no backoff: the r2 probe-kill
@@ -130,6 +141,13 @@ RAW_SPAN_ALLOW = {
     "bench.py::measure_dispatch_overhead",
     "bench.py::timed_fetch",
     "bench.py::chain_timed_fetch",
+    "bench.py::chained_scan_step_samples",
+}
+METRIC_AGG_ALLOW = {
+    # the documented dispatch-overhead probe: median-of-7 trivial
+    # dispatches IS the methodology (bench.py module docstring) and its
+    # output is an input to the metrics plane, not a latency digest
+    "bench.py::measure_dispatch_overhead",
 }
 
 _REF_PATTERNS = (
@@ -492,6 +510,95 @@ def rule_device_get_in_serving_loop(tree, lines, relpath) -> List[Finding]:
     return out
 
 
+_STAT_FNS = {"percentile", "quantile", "quantiles", "median"}
+
+
+def rule_raw_metric_aggregation(tree, lines, relpath) -> List[Finding]:
+    """Hand-rolled percentile/median arithmetic in a chip-path script
+    (ISSUE 10 satellite): scope mirrors raw-span-timing — scripts/ + the
+    root chip scripts, narrowed to modules that acquire a backend. Two
+    signatures: (a) a call whose leaf name is a statistics function
+    (np.percentile/median/statistics.quantiles/...), (b) the
+    nearest-rank idiom — `round(q * (len(s) - 1))`-style rank
+    arithmetic, or indexing directly into a `sorted(...)` call with a
+    computed index. Both should be `obs.metrics.Histogram` digests."""
+    if not (relpath in QUEUE_RULE_FILES
+            or any(relpath.startswith(p) for p in QUEUE_RULE_PREFIXES)):
+        return []
+    if not _acquires_backend(tree):
+        return []
+
+    def contains_len_call(node) -> bool:
+        return any(isinstance(n, ast.Call) and _call_name(n) == "len"
+                   for n in ast.walk(node))
+
+    out = []
+    for qual, node, body in _iter_scopes(tree):
+        if "%s::%s" % (relpath, qual) in METRIC_AGG_ALLOW \
+                or "%s::%s" % (os.path.basename(relpath), qual) \
+                in METRIC_AGG_ALLOW:
+            continue
+        for call in _scope_calls(body):
+            name = _call_name(call)
+            leaf = name.split(".")[-1]
+            root_mod = name.split(".")[0]
+            hit = None
+            # stat-library calls only (np.percentile, statistics.median,
+            # a bare percentile): `Histogram.quantile()` IS the sanctioned
+            # digest and must not flag itself
+            if leaf in _STAT_FNS and (name == leaf or root_mod in
+                                      ("np", "numpy", "statistics",
+                                       "scipy")):
+                hit = "%s()" % name
+            elif leaf == "round" and any(
+                    isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mult)
+                    and contains_len_call(n)
+                    for a in call.args for n in ast.walk(a)):
+                hit = "rank arithmetic (round(q * (len(..) - 1)))"
+            if hit is None:
+                continue
+            if _suppressed("raw-metric-aggregation", lines, call.lineno,
+                           getattr(call, "end_lineno", call.lineno)):
+                continue
+            out.append(Finding(
+                rule="ast/raw-metric-aggregation", path=relpath,
+                line=call.lineno, context=qual,
+                message="hand-rolled metric aggregation (%s) in a "
+                        "chip-path script: ad-hoc percentiles neither "
+                        "merge nor export — observe into an obs.metrics "
+                        "Histogram and read quantile()/digest() (the SLO "
+                        "watchdog and perfgate consume those snapshots)"
+                        % hit))
+        # the sorted-then-index idiom outside calls (s = sorted(v);
+        # s[int(...)] on the sorted() call directly)
+        stack: List[ast.AST] = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+            if isinstance(n, ast.Subscript) \
+                    and isinstance(n.value, ast.Call) \
+                    and _call_name(n.value) == "sorted" \
+                    and not isinstance(n.slice, ast.Constant) \
+                    and not (isinstance(n.slice, ast.UnaryOp)
+                             and isinstance(n.slice.operand, ast.Constant)):
+                if "%s::%s" % (relpath, qual) in METRIC_AGG_ALLOW:
+                    continue
+                if _suppressed("raw-metric-aggregation", lines, n.lineno,
+                               getattr(n, "end_lineno", n.lineno)):
+                    continue
+                out.append(Finding(
+                    rule="ast/raw-metric-aggregation", path=relpath,
+                    line=n.lineno, context=qual,
+                    message="computed index into sorted(...) (the "
+                            "nearest-rank percentile idiom) in a "
+                            "chip-path script — observe into an "
+                            "obs.metrics Histogram instead"))
+    return out
+
+
 def _subtree_nodes(root) -> Iterable[ast.AST]:
     """Every node under `root` (inclusive), NOT descending into nested
     function/class defs — loop analysis must not be confused by a
@@ -559,7 +666,8 @@ def rule_unbounded_retry(tree, lines, relpath) -> List[Finding]:
 RULES = (rule_per_call_timing, rule_queue_bypass, rule_env_platform_write,
          rule_raw_artifact_write, rule_device_get_in_loop,
          rule_missing_ref_citation, rule_raw_span_timing,
-         rule_device_get_in_serving_loop, rule_unbounded_retry)
+         rule_device_get_in_serving_loop, rule_unbounded_retry,
+         rule_raw_metric_aggregation)
 
 
 # ---------------------------------------------------------------------------

@@ -1,6 +1,6 @@
-"""obs/ — the flight recorder: step telemetry, span tracing, host context.
+"""obs/ — the flight recorder: step telemetry, spans, metrics, SLO rules.
 
-Three coordinated parts (ISSUE 6; the reference has no observability at
+Coordinated parts (ISSUEs 6 + 10; the reference has no observability at
 all — its loop prints averaged meters, ref train.py:140-160):
 
 * `obs.telemetry` (jax): in-jit step scalars (grad/update/param norms +
@@ -9,13 +9,28 @@ all — its loop prints averaged meters, ref train.py:140-160):
 * `obs.spans` (stdlib): crash-safe JSONL span tracer for host-side phases
   (loader-wait/h2d/dispatch/fetch/checkpoint/compile/...).
 * `obs.context` (stdlib): loadavg + relay-liveness sampler.
+* `obs.metrics` (stdlib): the LIVE metrics plane — thread-safe counters/
+  gauges/fixed-layout mergeable histograms with crash-safe periodic
+  `obs-metrics-v1` snapshot export ($OBS_METRICS).
+* `obs.slo` (stdlib): the SLO watchdog — EWMA/z-score drift + error/
+  latency budget burn rules emitting `alert:*` events and degrading the
+  serving engine.
 
-This __init__ stays STDLIB-ONLY (spans/context re-exports): runtime/ —
-which must never build the ML stack — imports `obs.spans` for
-beats-become-spans mirroring. Import `obs.telemetry` directly where jax
-is already loaded (train.py, bench.py).
+This __init__ stays STDLIB-ONLY (spans/context/metrics/slo re-exports):
+runtime/ — which must never build the ML stack — imports `obs.spans` for
+beats-become-spans mirroring and `obs.metrics` for the supervisor gauges.
+Import `obs.telemetry` directly where jax is already loaded (train.py,
+bench.py).
 """
 
 from .context import sample_context  # noqa: F401
+from .metrics import (METRICS_SCHEMA, OBS_METRICS_ENV,  # noqa: F401
+                      Counter, Gauge, Histogram, MetricsRegistry,
+                      MetricsWriter, default_registry, maybe_writer,
+                      read_latest, read_metrics, reset_default_registry,
+                      snapshot_digest)
+from .slo import (DriftDetector, DriftRule, ErrorBurnRule,  # noqa: F401
+                  LatencyBurnRule, SloWatchdog, default_serving_rules,
+                  default_train_rules)
 from .spans import (OBS_SPAN_ENV, SPAN_SCHEMA, Span,  # noqa: F401
                     SpanTracer, maybe_tracer, read_spans)

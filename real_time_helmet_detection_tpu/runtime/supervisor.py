@@ -173,12 +173,40 @@ class Supervisor:
                                             flush=True))
         self.waiter = None
         self.waiters_spawned = 0   # tests assert "exactly one" / "zero"
+        # Live metrics plane (ISSUE 10): job-state gauges + heartbeat age
+        # + requeue/salvage counters, exported when $OBS_METRICS is set
+        # (crash-safe periodic snapshots; obs.metrics is stdlib-only, so
+        # the no-ML-stack rule holds). queue.jobs.<state> gauges track the
+        # spool's live census; queue.heartbeat_age_s is the running job's
+        # silence — the number the stale-kill deadline acts on.
+        from ..obs.metrics import default_registry, maybe_writer
+        self._metrics = default_registry()
+        self._m_writer = maybe_writer(registry=self._metrics)
+        self._mg_hb_age = self._metrics.gauge("queue.heartbeat_age_s")
+        self._mc_requeues = self._metrics.counter("queue.requeues")
+        self._mc_salvages = self._metrics.counter("queue.salvages")
         # Health verification is CACHED: once the claim has cleared (or a
         # job succeeded — the strongest possible probe), later jobs skip
         # the waiter. A waiter is itself a jax.devices() process: parking
         # one per job would contend with the RUNNING job for the claim
         # (one process per chip). Any transient trouble invalidates it.
         self._verified_healthy = False
+
+    # ---- metrics seam ----------------------------------------------------
+
+    def _sample_metrics(self, hb_age: Optional[float] = None) -> None:
+        """Refresh the queue.* gauges from the spool census (+ the running
+        job's heartbeat age when given) and give the exporter its periodic
+        flush point. Pure host bookkeeping; called from the poll loops."""
+        counts: dict = {}
+        for js in self.spool.ordered():
+            counts[js.state] = counts.get(js.state, 0) + 1
+        for state in (QUEUED, CLAIM_WAIT, RUNNING, DONE, FAILED, SALVAGED):
+            self._metrics.gauge("queue.jobs.%s" % state).set(
+                counts.get(state, 0))
+        if hb_age is not None:
+            self._mg_hb_age.set(hb_age)
+        self._m_writer.maybe_flush()
 
     # ---- heartbeat seam --------------------------------------------------
 
@@ -324,8 +352,10 @@ class Supervisor:
             rc = handle.poll()
             if rc is not None:
                 self._finish_job(js, rc)
+                self._sample_metrics(hb_age=0.0)
                 return
             age = self._hb_age(hb_path, started)
+            self._sample_metrics(hb_age=age)
             if age > js.spec.heartbeat_timeout_s:
                 self._log("job %s heartbeat stale %.0fs (deadline %.0fs); "
                           "killing" % (job, age,
@@ -396,6 +426,7 @@ class Supervisor:
         self._verified_healthy = False
         job = js.spec.job
         salvaged = self._salvage(js)
+        self._mc_salvages.inc()
         self.spool.transition(job, SALVAGED, reason=reason, rc=rc,
                               salvaged_artifacts=salvaged)
         self._log("job %s salvaged (%d artifact(s) survived): %s"
@@ -408,6 +439,7 @@ class Supervisor:
                       % (job, js.spec.max_attempts))
             return
         delay = self._backoff_s(js.attempt, js.spec)
+        self._mc_requeues.inc()
         self.spool.transition(job, QUEUED, attempt=js.attempt + 1,
                               not_before=self.clock() + delay,
                               reason=reason)
@@ -423,6 +455,7 @@ class Supervisor:
         them on the next invocation (the driver's chance to alert a human
         instead of hanging forever)."""
         self.recover()
+        self._sample_metrics()
         parked_since = None
         while True:
             job = self.spool.next_runnable(self.clock())
@@ -446,6 +479,7 @@ class Supervisor:
                                     parked_s=now - parked_since)
                     self._log("relay dead for %.0fs; exiting parked (queue "
                               "persists)" % (now - parked_since))
+                    self._m_writer.maybe_flush(force=True)
                     return self.summary(parked=True)
                 self._log("relay dead: parked (no waiter spawned); "
                           "re-probing in %.0fs" % self.park_retry_s)
@@ -456,6 +490,8 @@ class Supervisor:
                 if not self._await_claim(job):
                     continue  # relay died mid-wait; job is queued again
             self._run_job(job)
+        self._sample_metrics()
+        self._m_writer.maybe_flush(force=True)
         return self.summary()
 
     def summary(self, parked: bool = False) -> dict:

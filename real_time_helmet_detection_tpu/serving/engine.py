@@ -84,6 +84,19 @@ Design rules, each load-bearing:
   (the fetch — where un-hidden device time surfaces, exactly like
   eval's `fetch` span) / `serve:e2e` per request; `$OBS_SPAN_LOG` is
   honored via `obs.spans.maybe_tracer`.
+* **Live metrics plane (ISSUE 10).** Every admission decision, batch
+  outcome and pipeline stage also lands in an `obs.metrics` registry:
+  `serve.*` counters (submitted/completed/shed/retried/requeued/
+  failed), queue-depth + per-bucket fill gauges, and per-stage
+  h2d/compute/d2h/e2e latency histograms — all HOST-side bookkeeping
+  (the executed programs are bit-identical with metrics on or off, and
+  the per-batch D2H stays the only fetch). `health()` folds the
+  digested registry in; `$OBS_METRICS` arms crash-safe periodic
+  snapshot export. An optional `obs.slo.SloWatchdog` is poked after
+  every batch outcome: a burning error/latency budget flips the engine
+  to DEGRADED via `degrade()` BEFORE the chaos-ladder failure modes
+  would — alerts are deterministic under `runtime/faults.py` replay
+  because they derive from the (deterministic) batch outcome sequence.
 """
 
 from __future__ import annotations
@@ -228,6 +241,12 @@ class ServingEngine:
     recover_after : consecutive healthy batches that clear DEGRADED.
     injector : optional `runtime.faults.ChaosInjector` for deterministic
         fault replay (tests/serve_bench --faults); None = zero overhead.
+    metrics : optional `obs.metrics.MetricsRegistry`; default = the
+        process-wide registry (so one $OBS_METRICS export covers every
+        instrumented module). Pass a fresh registry for isolated runs
+        (serve_bench, tests).
+    watchdog : optional `obs.slo.SloWatchdog`, poked after every batch
+        outcome; serving alerts degrade THIS engine.
     """
 
     def __init__(self, predict, variables, image_shape: Sequence[int],
@@ -236,9 +255,11 @@ class ServingEngine:
                  queue_capacity: int = 128, sharding=None, tracer=None,
                  start: bool = True, max_retries: int = 2,
                  hang_timeout_s: Optional[float] = None,
-                 recover_after: int = 2, injector=None):
+                 recover_after: int = 2, injector=None, metrics=None,
+                 watchdog=None):
         import jax
 
+        from ..obs import metrics as metrics_mod
         from ..obs.spans import maybe_tracer
 
         self._buckets = tuple(sorted({int(b) for b in buckets}))
@@ -255,6 +276,25 @@ class ServingEngine:
                                 else max(1e-3, float(hang_timeout_s)))
         self._recover_after = max(1, int(recover_after))
         self._injector = injector
+        # live metrics plane (ISSUE 10): host-side handles, created once
+        # so the hot loops do dict-free inc/observe calls
+        self._metrics = (metrics if metrics is not None
+                         else metrics_mod.default_registry())
+        self._m_writer = metrics_mod.maybe_writer(registry=self._metrics)
+        self._watchdog = watchdog
+        mm = self._metrics
+        self._mc = {name: mm.counter("serve." + name) for name in (
+            "submitted", "completed", "batches_total", "batch_slots",
+            "padded_slots", "shed_queue_full", "shed_deadline", "retried",
+            "requeued_batches", "failed_batches", "hung_batches",
+            "retry_exhausted", "reloads")}
+        self._mg_queue = mm.gauge("serve.queue_depth")
+        self._mg_retry = mm.gauge("serve.retry_depth")
+        self._mg_inflight = mm.gauge("serve.inflight_batches")
+        self._mh = {name: mm.histogram("serve.%s_ms" % name) for name in (
+            "queue_wait", "batch_form", "h2d", "compute", "d2h", "e2e")}
+        self._mg_fill = {b: mm.gauge("serve.fill.b%d" % b)
+                         for b in self._buckets}
 
         self._variables = self._commit_variables(variables)
         # AOT: one compile per bucket, at construction, from the SAME
@@ -338,6 +378,7 @@ class ServingEngine:
             self._retry.popleft().future._fail(
                 EngineClosedError("engine closed"))
         self._set_state(CLOSED)
+        self._m_writer.close()  # final metrics snapshot (when $OBS_METRICS)
 
     def __enter__(self) -> "ServingEngine":
         return self
@@ -359,22 +400,47 @@ class ServingEngine:
     def state(self) -> str:
         return self._state
 
+    def degrade(self, reason: str) -> None:
+        """External DEGRADED flip (the SLO watchdog's lever, ISSUE 10):
+        the engine keeps serving but advertises trouble, exactly as after
+        a batch failure; `recover_after` consecutive healthy batches
+        clear it. A closed engine ignores the poke."""
+        with self._lock:
+            self._consecutive_ok = 0
+            self._last_error = "degraded: %s" % str(reason)[:200]
+        self._tracer.event("serve:degrade", reason=str(reason)[:200])
+        self._set_state(DEGRADED)
+
     def health(self) -> Dict:
         """Point-in-time health snapshot (the load-balancer / chaos-suite
-        API): state machine position, backlog depths, failure counters."""
+        API): state machine position, backlog depths, failure counters,
+        plus the digested live metrics (per-stage latency p50/p99, fill
+        and depth gauges — ISSUE 10's extended health surface)."""
         with self._lock:
             stats = dict(self._stats)
             consec_fail = self._consecutive_failures
             inflight = self._inflight_batches
             last_error = self._last_error
-        return {"state": self._state, "queued": self._q.qsize(),
-                "retry_queued": len(self._retry),
-                "inflight_batches": inflight,
-                "consecutive_failures": consec_fail,
-                "buckets": list(self._buckets),
-                "max_retries": self._max_retries,
-                "hang_timeout_s": self._hang_timeout_s,
-                "last_error": last_error, "stats": stats}
+        out = {"state": self._state, "queued": self._q.qsize(),
+               "retry_queued": len(self._retry),
+               "inflight_batches": inflight,
+               "consecutive_failures": consec_fail,
+               "buckets": list(self._buckets),
+               "max_retries": self._max_retries,
+               "hang_timeout_s": self._hang_timeout_s,
+               "last_error": last_error, "stats": stats,
+               "metrics": self._metrics.digest(prefix="serve.")}
+        if self._watchdog is not None:
+            out["alerts"] = list(self._watchdog.alerts)
+        return out
+
+    def _after_batch_outcome(self) -> None:
+        """Post-outcome hook shared by the healthy and failed paths: poke
+        the SLO watchdog (alerts may degrade THIS engine) and give the
+        metrics exporter its periodic flush point. Host-side only."""
+        if self._watchdog is not None:
+            self._watchdog.check(engine=self)
+        self._m_writer.maybe_flush()
 
     def _is_idle(self) -> bool:
         with self._lock:
@@ -414,6 +480,7 @@ class ServingEngine:
                 self._variables = self._commit_variables(variables)
                 with self._lock:
                     self._stats["reloads"] += 1
+                self._mc["reloads"].inc()
         self._set_state(SERVING)
 
     # ---- client API ------------------------------------------------------
@@ -454,13 +521,16 @@ class ServingEngine:
         req = _Request(image, fut)
         with self._lock:
             self._stats["submitted"] += 1
+        self._mc["submitted"].inc()
         try:
             self._q.put(req, block=block, timeout=timeout)
         except queue.Full:
             with self._lock:
                 self._stats["shed_queue_full"] += 1
+            self._mc["shed_queue_full"].inc()
             self._tracer.event("serve:shed", reason="queue-full")
             fut._fail(SheddedError("queue full (admission control)"))
+        self._mg_queue.set(self._q.qsize())
         return fut
 
     def predict_many(self, images: Sequence[np.ndarray]) -> List:
@@ -496,6 +566,12 @@ class ServingEngine:
             self._last_error = "%s: %s" % (type(error).__name__,
                                            str(error).splitlines()[0][:200]
                                            if str(error) else "")
+        self._mc["failed_batches"].inc()
+        self._mc["retried"].inc(retried)
+        self._mc["retry_exhausted"].inc(exhausted)
+        if retried:
+            self._mc["requeued_batches"].inc()
+        self._mg_retry.set(len(self._retry))
         self._set_state(DEGRADED)
         self._tracer.event("recover:requeue", stage=stage, b=b, n=retried,
                            error=type(error).__name__)
@@ -507,6 +583,7 @@ class ServingEngine:
                 self._q.put_nowait(_WAKE)
             except queue.Full:
                 pass  # a full queue means the dispatcher wakes anyway
+        self._after_batch_outcome()
 
     def _note_batch_ok(self) -> None:
         with self._lock:
@@ -516,6 +593,7 @@ class ServingEngine:
                          and self._consecutive_ok >= self._recover_after)
         if recovered:
             self._set_state(SERVING)
+        self._after_batch_outcome()
 
     # ---- dispatcher ------------------------------------------------------
 
@@ -532,6 +610,7 @@ class ServingEngine:
             if r.future.deadline is not None and now > r.future.deadline:
                 with self._lock:
                     self._stats["shed_deadline"] += 1
+                self._mc["shed_deadline"].inc()
                 self._tracer.event("serve:shed", reason="deadline")
                 r.future._fail(SheddedError("deadline passed before "
                                             "dispatch"))
@@ -599,7 +678,8 @@ class ServingEngine:
                     self._dispatch_busy = False
                 continue
             with self._dispatch_mutex:
-                with self._tracer.span("serve:batch-form", n=len(live)):
+                with self._tracer.span("serve:batch-form",
+                                       n=len(live)) as sp_form:
                     b = self._pick_bucket(len(live))
                     # a fresh buffer per batch: the async H2D of the
                     # previous dispatch may still be reading its buffer
@@ -607,29 +687,42 @@ class ServingEngine:
                                    self._image_dtype)
                     for i, r in enumerate(live):
                         buf[i] = r.image
+                self._mh["batch_form"].observe(sp_form.dur_s * 1e3)
                 now = time.monotonic()
                 for r in live:
                     self._tracer.record("serve:queue-wait",
                                         now - r.future.t_submit)
+                    self._mh["queue_wait"].observe(
+                        (now - r.future.t_submit) * 1e3)
                 try:
                     if self._injector is not None:
                         self._injector.fire("serve:dispatch", b=b)
-                    with self._tracer.span("serve:h2d", b=b):
+                    with self._tracer.span("serve:h2d", b=b) as sp_h2d:
                         dev = (jax.device_put(buf, self._sharding)
                                if self._sharding is not None
                                else jax.device_put(buf))
-                    with self._tracer.span("serve:compute", b=b):
+                    with self._tracer.span("serve:compute",
+                                           b=b) as sp_comp:
                         out = self._compiled[b](self._variables, dev)
                 except Exception as e:  # noqa: BLE001 — requeue, serve on
                     self._requeue_or_fail(live, e, stage="dispatch", b=b)
                     with self._lock:
                         self._dispatch_busy = False
                     continue
+                self._mh["h2d"].observe(sp_h2d.dur_s * 1e3)
+                self._mh["compute"].observe(sp_comp.dur_s * 1e3)
                 with self._lock:
                     self._stats["batches"] += 1
                     self._stats["padded_slots"] += b - len(live)
                     self._inflight_batches += 1
                     self._dispatch_busy = False
+                    inflight = self._inflight_batches
+                self._mc["batches_total"].inc()
+                self._mc["batch_slots"].inc(b)
+                self._mc["padded_slots"].inc(b - len(live))
+                self._mg_fill[b].set(len(live) / b)
+                self._mg_inflight.set(inflight)
+                self._mg_queue.set(self._q.qsize())
             self._inflight.put((out, live, b))  # depth-bounded: blocks at
             # `depth` in-flight batches — the pipelining backpressure
         self._inflight.put(_SENTINEL)
@@ -664,6 +757,7 @@ class ServingEngine:
         if not done.wait(self._hang_timeout_s):
             with self._lock:
                 self._stats["hung_batches"] += 1
+            self._mc["hung_batches"].inc()
             raise FetchHungError(
                 "batch (bucket %d) D2H exceeded the %.3fs hang watchdog"
                 % (b, self._hang_timeout_s))
@@ -678,7 +772,8 @@ class ServingEngine:
                 return
             out, live, b = item
             try:
-                with self._tracer.span("serve:d2h", b=b, n=len(live)):
+                with self._tracer.span("serve:d2h", b=b,
+                                       n=len(live)) as sp_d2h:
                     # the ONE sanctioned batched fetch (graftlint
                     # ast/device-get-in-serving-loop polices per-request
                     # fetches; this one D2H serves the whole batch)
@@ -688,8 +783,10 @@ class ServingEngine:
                 with self._lock:
                     self._inflight_batches -= 1
                 continue
+            self._mh["d2h"].observe(sp_d2h.dur_s * 1e3)
             with self._lock:
                 self._stats["completed"] += len(live)
+            self._mc["completed"].inc(len(live))
             for i, r in enumerate(live):
                 # completion stamps come from the future itself (_set
                 # records t_done), so the e2e record is pure arithmetic
@@ -699,6 +796,10 @@ class ServingEngine:
                                            for leaf in host)))
                 self._tracer.record(
                     "serve:e2e", r.future.t_done - r.future.t_submit, b=b)
+                self._mh["e2e"].observe(
+                    (r.future.t_done - r.future.t_submit) * 1e3)
             with self._lock:
                 self._inflight_batches -= 1
+                inflight = self._inflight_batches
+            self._mg_inflight.set(inflight)
             self._note_batch_ok()

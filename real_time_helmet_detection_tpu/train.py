@@ -1066,12 +1066,20 @@ class SentinelMonitor:
     MIN_SCALE = 1.0 / 1024.0
 
     def __init__(self, cfg: Config, tracer=None):
+        from .obs.metrics import default_registry
         self.cfg = cfg
         self._tracer = tracer
         self.scale = 1.0
         self.skipped = 0
         self.consecutive_bad = 0
         self.rollbacks = 0
+        # live metrics plane (ISSUE 10): the sentinel's decisions ride the
+        # train.* namespace next to the loop histograms — host counters
+        # over already-fetched scalars, zero extra D2H
+        mreg = default_registry()
+        self._m_skipped = mreg.counter("train.skipped_steps")
+        self._m_rollbacks = mreg.counter("train.rollbacks")
+        self._mg_scale = mreg.gauge("train.loss_scale")
 
     def scale_value(self) -> float:
         """The runner's per-call loss-scale source (make_step_runner)."""
@@ -1093,6 +1101,7 @@ class SentinelMonitor:
             else:
                 self.consecutive_bad = 0
         if window_bad:
+            self._m_skipped.inc(window_bad)
             if self._tracer is not None:
                 self._tracer.event("recover:skip-step", n=window_bad,
                                    total=self.skipped)
@@ -1104,6 +1113,7 @@ class SentinelMonitor:
                 self.scale = new_scale
         elif self.scale < 1.0:
             self.scale = min(1.0, self.scale * 2.0)
+        self._mg_scale.set(self.scale)
         if diverged:
             raise TrainingDivergenceError(
                 "sentinel: %d consecutive skipped steps (>= "
@@ -1115,8 +1125,10 @@ class SentinelMonitor:
         blowup, so the backoff (aimed at the diverged trajectory) resets
         with it."""
         self.rollbacks += 1
+        self._m_rollbacks.inc()
         self.consecutive_bad = 0
         self.scale = 1.0
+        self._mg_scale.set(self.scale)
 
 
 def _poison_batch(batch):
@@ -1139,7 +1151,7 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
                 epoch_base_step: int = 0, watchdog=None,
                 injector: Optional[FaultInjector] = None,
                 tracer=None, monitor: Optional[SentinelMonitor] = None,
-                chaos=None) -> TrainState:
+                chaos=None, mwriter=None, slo=None) -> TrainState:
     """One epoch of the hot loop (≡ ref train.py:86-162 `train_step`).
 
     `tracer` (obs/spans.py, optional): when span tracing is enabled the
@@ -1154,10 +1166,23 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
     loss-scale backoff and the divergence escalation. `chaos`
     (runtime.faults.ChaosInjector, tests only): fires the `train:batch`
     site per iteration — a `nan-batch` event poisons the host batch so
-    the in-jit sentinel path is exercisable deterministically."""
+    the in-jit sentinel path is exercisable deterministically.
+
+    `mwriter`/`slo` (ISSUE 10): the loop's host-side walls feed the
+    train.* histograms of the live metrics plane and the SLO drift
+    watchdog (step-time/loss z-scores -> `alert:*` events); `mwriter`
+    gets its periodic flush point at the loss-flush barrier. All of it
+    is host bookkeeping over ALREADY-measured values — the traced
+    programs and the single-fetch D2H contract are untouched."""
+    from .obs.metrics import default_registry
     from .obs.spans import SpanTracer
     if tracer is None:
         tracer = SpanTracer(None)  # disabled: wrap() is identity
+    mreg = default_registry()
+    mh_step = mreg.histogram("train.step_ms")
+    mh_wait = mreg.histogram("train.loader_wait_ms")
+    mh_fetch = mreg.histogram("train.fetch_ms")
+    mc_steps = mreg.counter("train.steps")
     # segment meters are host-visible averages made honest by the
     # periodic flush barrier (see `pending` below), not per-call device
     # timing — bench.py owns that: graftlint: off=per-call-timing
@@ -1181,11 +1206,19 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
         # ONE device_get for the whole interval — the span around it is
         # the loop's true completion barrier (any device time the host
         # work failed to hide shows up here, not in `step`)
-        with tracer.span("fetch", steps=len(pending)):
+        with tracer.span("fetch", steps=len(pending)) as sp_fetch:
             fetched_all = jax.device_get(pending)
+        mh_fetch.observe(sp_fetch.dur_s * 1e3)
         for fetched in fetched_all:
             loss_log.append(fetched)
+        if slo is not None:
+            # loss drift rides the already-fetched window (zero extra D2H)
+            for fetched in fetched_all:
+                slo.observe("train.loss", float(fetched.get("total", 0.0)))
         pending.clear()
+        if mwriter is not None:
+            mwriter.maybe_flush()  # the periodic export point: the flush
+            # barrier is where the host is synced anyway
         if monitor is not None:
             # the sentinel scalars rode the SAME fetch; observe() may
             # raise TrainingDivergenceError -> train()'s rollback branch
@@ -1212,6 +1245,7 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
                 batch = _poison_batch(batch)
         data_t = time.time() - tic
         meters["data"].update(data_t)
+        mh_wait.observe(data_t * 1e3)
         if tracer.enabled:
             tracer.record("loader-wait", data_t, epoch=epoch, it=i)
 
@@ -1232,6 +1266,12 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
                 watchdog.beat("epoch %d iter %d (flushed)" % (epoch, i))
         step_t = time.time() - tic - data_t
         meters["step"].update(step_t)
+        mh_step.observe(step_t * 1e3)
+        mc_steps.inc()
+        if slo is not None:
+            # drift on the same host wall the meter records; an alert is
+            # an alert:train-step-drift event in the span log
+            slo.observe("train.step_ms", step_t * 1e3)
         if tracer.enabled:
             # async-dispatch time (+ the flush barrier's device wait when
             # this was a flush iteration) — same semantics as the meter
@@ -1433,6 +1473,20 @@ def train(cfg: Config, chaos=None) -> TrainState:
         if is_chief:
             print("%s: span log -> %s" % (timestamp(), tracer.path),
                   flush=True)
+    # Live metrics plane + SLO watchdog (ISSUE 10): the loop's host-side
+    # measurements (step/loader-wait/fetch walls, sentinel skips) feed
+    # in-memory train.* metrics regardless — $OBS_METRICS only arms the
+    # crash-safe periodic snapshot export, and the drift watchdog turns a
+    # creeping step time or a wandering loss into `alert:*` span events.
+    # Nothing here touches the jitted programs or adds a D2H (count-pinned
+    # by tests/test_metrics_plane.py).
+    from .obs.metrics import maybe_writer
+    from .obs.slo import SloWatchdog, default_train_rules
+    mwriter = maybe_writer()
+    slo = SloWatchdog(default_train_rules(), tracer=tracer)
+    if mwriter.enabled and is_chief:
+        print("%s: metrics export -> %s" % (timestamp(), mwriter.path),
+              flush=True)
     watchdog = HangWatchdog(cfg.hang_warn_seconds,
                             beat_file=os.environ.get(HEARTBEAT_ENV))
     if hasattr(loader, "worker_status"):
@@ -1459,7 +1513,8 @@ def train(cfg: Config, chaos=None) -> TrainState:
                     profile_this_epoch=(cfg.profile and epoch == start_epoch),
                     epoch_base_step=epoch * steps_per_epoch,
                     watchdog=watchdog, injector=injector, tracer=tracer,
-                    monitor=monitor, chaos=chaos)
+                    monitor=monitor, chaos=chaos, mwriter=mwriter,
+                    slo=slo)
                 if epoch_flush is not None and int(jax.device_get(
                         state.opt_state.mini_step)):
                     # partial accumulation window at epoch end: flush it
@@ -1633,10 +1688,17 @@ def train(cfg: Config, chaos=None) -> TrainState:
         watchdog.pause("finalizing checkpoints")
         writer.finalize()
         watchdog.stop()
+        if hasattr(loader, "quarantined"):
+            # the SHM loader's poison-batch quarantine count (ISSUE 9)
+            # lands on the metrics plane next to the sentinel counters
+            from .obs.metrics import default_registry
+            default_registry().gauge("train.quarantined_batches").set(
+                loader.quarantined)
         if hasattr(loader, "close"):
             loader.close()  # reap workers, unlink shared-memory slots
         if tracer.enabled and recompiles is not None:
             tracer.event("recompile-total", count=recompiles.count,
                          total_s=round(recompiles.total_s, 3))
+        mwriter.close()  # final metrics snapshot (no-op unless exporting)
         tracer.close()
     return state

@@ -197,6 +197,32 @@ AST_FIXTURES = {
         "        except Exception:\n"
         "            continue\n",
     ),
+    "raw-metric-aggregation": (
+        # a chip-path script hand-rolling a nearest-rank percentile +
+        # an np.percentile call over per-request latencies
+        "import numpy as np, jax\n"
+        "from real_time_helmet_detection_tpu.runtime import run_as_job\n"
+        "def pctl(vals, q):\n"
+        "    s = sorted(vals)\n"
+        "    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]\n"
+        "def main():\n"
+        "    jax.devices()\n"
+        "    lats = [0.1, 0.2]\n"
+        "    rec = {'p50': pctl(lats, 0.5),\n"
+        "           'p99': float(np.percentile(lats, 99))}\n"
+        "run_as_job(main)\n",
+        # the same script routed through the metrics plane
+        "import jax\n"
+        "from real_time_helmet_detection_tpu.obs.metrics import Histogram\n"
+        "from real_time_helmet_detection_tpu.runtime import run_as_job\n"
+        "def main():\n"
+        "    jax.devices()\n"
+        "    h = Histogram('lat_ms')\n"
+        "    for v in (0.1, 0.2):\n"
+        "        h.observe(v * 1e3)\n"
+        "    rec = {'p50': h.quantile(0.5), 'p99': h.quantile(0.99)}\n"
+        "run_as_job(main)\n",
+    ),
     "raw-span-timing": (
         # a chip-path script (acquires a backend) timing a span by hand
         "import time\n"

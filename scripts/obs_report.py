@@ -11,13 +11,21 @@ ONE artifact:
 * the tpu_queue job journal        (artifacts/<round>/queue/jobs.jsonl:
   per-job state transitions, attempts, salvages),
 * bench JSON lines                 (BENCH_*_local.json under the round),
-* loss_log.json sidecars           (loss-log-v1 or -v2, --loss-log PATH).
+* loss_log.json sidecars           (loss-log-v1 or -v2, --loss-log PATH),
+* live metrics snapshots           (obs-metrics-v1 JSONL under
+  artifacts/<round>/obs/metrics*.jsonl — the $OBS_METRICS exports:
+  counters/gauges verbatim, histograms digested to p50/p99; ISSUE 10),
+* SLO alert events                 (`alert:*` in the span logs, joined
+  into one timeline with the `fault:*`/`recover:*` evidence so a
+  post-mortem reads what the watchdog saw next to what actually broke
+  and what healed; ISSUE 10).
 
 Output: `artifacts/<round>/obs/report.md` (human) + `report.json` and ONE
-JSON line on stdout (machine), schema `obs-report-v1`. Everything is
-read-only over its inputs (the queue journal is parsed tolerantly, torn
-tails dropped, never repaired in place) and CPU-only — run it after any
-round, chip or not.
+JSON line on stdout (machine), schema `obs-report-v2` (v1 reports —
+pre-metrics rounds — stay readable via `read_report`, which nulls the
+sections v1 lacks). Everything is read-only over its inputs (the queue
+journal is parsed tolerantly, torn tails dropped, never repaired in
+place) and CPU-only — run it after any round, chip or not.
 
 Usage:
 
@@ -41,12 +49,36 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from bench import graft_round  # noqa: E402 — one shared round default
+from real_time_helmet_detection_tpu.obs.metrics import (  # noqa: E402
+    read_metrics, snapshot_digest)
 from real_time_helmet_detection_tpu.obs.spans import (  # noqa: E402
     maybe_tracer, read_spans)
 from real_time_helmet_detection_tpu.utils import (  # noqa: E402
     atomic_write_bytes, save_json)
 
-SCHEMA = "obs-report-v1"
+SCHEMA = "obs-report-v2"
+READABLE_SCHEMAS = ("obs-report-v1", "obs-report-v2")
+# sections a v1 (pre-ISSUE-10) report lacks; read_report nulls them
+V2_SECTIONS = ("metrics", "slo")
+
+
+def read_report(path: str) -> Optional[Dict]:
+    """Load a report.json of ANY readable schema, normalized to the v2
+    shape (missing v2 sections -> None). Consumers (perfgate's obs
+    source, tests) read old rounds' committed reports through this
+    instead of sniffing schemas themselves. Unknown schemas refuse
+    loudly (None) rather than half-parse."""
+    try:
+        with open(path) as f:
+            rep = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if rep.get("schema") not in READABLE_SCHEMAS:
+        log("unreadable report schema %r in %s" % (rep.get("schema"), path))
+        return None
+    for section in V2_SECTIONS:
+        rep.setdefault(section, None)
+    return rep
 
 
 def log(msg: str) -> None:
@@ -221,6 +253,66 @@ def summarize_faults(paths: List[str]) -> Optional[Dict]:
             "engine_transitions": transitions}
 
 
+def summarize_metrics(paths: List[str]) -> Optional[Dict]:
+    """The Metrics section (ISSUE 10): per obs-metrics-v1 JSONL, the
+    LAST complete snapshot digested (counters/gauges verbatim,
+    histograms to count/mean/p50/p99/max) plus the snapshot count — a
+    reader sees the final state of every exported registry without
+    spelunking raw bucket arrays. Returns None when the round exported
+    no metrics (a pre-ISSUE-10 round)."""
+    out = []
+    for path in sorted(paths):
+        snaps = read_metrics(path)
+        # tolerate a spans-style meta line or foreign records: a metrics
+        # snapshot is recognizable by its histogram/counter sections
+        snaps = [s for s in snaps
+                 if isinstance(s, dict) and ("counters" in s
+                                             or "histograms" in s)]
+        if not snaps:
+            continue
+        row = {"path": os.path.relpath(path, REPO)
+               if path.startswith(REPO) else path,
+               "snapshots": len(snaps)}
+        row.update(snapshot_digest(snaps[-1]))
+        out.append(row)
+    return {"files": out} if out else None
+
+
+def summarize_slo(paths: List[str]) -> Optional[Dict]:
+    """The SLO section (ISSUE 10): every `alert:*` watchdog event, with
+    counts by rule and a merged timeline against the `fault:*` /
+    `recover:*` / `serve:state` evidence (sorted by wall time) — the
+    post-mortem question "did the watchdog see it, and when relative to
+    the failure" answered in one table. Returns None when no alerts
+    fired."""
+    alerts: List[Dict] = []
+    timeline: List[Dict] = []
+    by_rule: Dict[str, int] = {}
+    for path in paths:
+        for rec in read_spans(path):
+            name = rec.get("name", "")
+            kind = rec.get("kind")
+            t = rec.get("t")
+            meta = rec.get("meta") or {}
+            if name.startswith("alert:"):
+                rule = name[len("alert:"):]
+                by_rule[rule] = by_rule.get(rule, 0) + 1
+                alerts.append({"t": t, "rule": rule, **meta})
+                timeline.append({"t": t, "what": "alert", "name": rule})
+            elif name.startswith(("fault:", "recover:")) \
+                    or name == "serve:state":
+                label = name if name != "serve:state" else (
+                    "serve:state %s->%s" % (meta.get("from", "?"),
+                                            meta.get("to", "?")))
+                timeline.append({"t": t, "what": kind or "event",
+                                 "name": label})
+    if not alerts:
+        return None
+    timeline.sort(key=lambda r: (r.get("t") is None, r.get("t")))
+    return {"alerts": alerts, "by_rule": by_rule,
+            "alert_total": len(alerts), "timeline": timeline}
+
+
 def summarize_queue(queue_dir: Optional[str]) -> Optional[Dict]:
     """Read-only tolerant replay of the job journal: per-job final state,
     attempts, salvage evidence, queued->terminal wall seconds."""
@@ -326,12 +418,15 @@ def summarize_loss_log(paths: List[str]) -> List[Dict]:
 
 def build_report(round_name: str, span_paths: List[str],
                  queue_dir: Optional[str], bench_paths: List[str],
-                 loss_paths: List[str]) -> Dict:
+                 loss_paths: List[str],
+                 metrics_paths: Optional[List[str]] = None) -> Dict:
     return {
         "schema": SCHEMA, "tool": "obs_report", "round": round_name,
         "spans": summarize_spans(span_paths),
         "serving": summarize_serving(span_paths),
         "faults": summarize_faults(span_paths),
+        "metrics": summarize_metrics(metrics_paths or []),
+        "slo": summarize_slo(span_paths),
         "queue": summarize_queue(queue_dir),
         "bench": summarize_bench(bench_paths),
         "loss": summarize_loss_log(loss_paths),
@@ -422,6 +517,47 @@ def render_markdown(rep: Dict) -> str:
     else:
         lines.append("_no fault/recovery activity recorded_")
     lines += [""]
+    mtr = rep.get("metrics")
+    lines += ["## Metrics", ""]
+    if mtr:
+        for row in mtr["files"]:
+            lines += ["`%s` — %d snapshot(s); final state:"
+                      % (row["path"], row["snapshots"]), ""]
+            if row.get("counters"):
+                lines += ["Counters: " + ", ".join(
+                    "%s=%d" % (k, v)
+                    for k, v in sorted(row["counters"].items()))]
+            gauges = {k: v for k, v in (row.get("gauges") or {}).items()
+                      if v is not None}
+            if gauges:
+                lines += ["Gauges: " + ", ".join(
+                    "%s=%.4g" % (k, v) for k, v in sorted(gauges.items()))]
+            if row.get("histograms"):
+                lines += ["", "| histogram | count | mean | p50 | p99 | "
+                          "max |", "|---|---|---|---|---|---|"]
+                for name, h in sorted(row["histograms"].items()):
+                    lines.append("| %s | %d | %s | %s | %s | %s |"
+                                 % (name, h["count"], h["mean"], h["p50"],
+                                    h["p99"], h["max"]))
+            lines += [""]
+    else:
+        lines.append("_no metrics snapshots found (export with "
+                     "$OBS_METRICS)_")
+    lines += [""]
+    slo = rep.get("slo")
+    lines += ["## SLO", ""]
+    if slo:
+        lines += ["Alerts: " + ", ".join(
+            "%s ×%d" % (k, v) for k, v in sorted(slo["by_rule"].items())),
+            "", "| t | what | name |", "|---|---|---|"]
+        for ev in slo["timeline"]:
+            lines.append("| %s | %s | %s |"
+                         % (("%.3f" % ev["t"]) if isinstance(
+                             ev.get("t"), (int, float)) else "?",
+                            ev["what"], ev["name"]))
+    else:
+        lines.append("_no SLO alerts fired_")
+    lines += [""]
     q = rep["queue"]
     lines += ["## Queue", ""]
     if q:
@@ -463,8 +599,11 @@ def generate(args) -> Dict:
     round_dir = os.path.join(REPO, "artifacts", round_name)
     span_paths = list(args.span_log or [])
     if not span_paths:
-        span_paths = sorted(glob.glob(os.path.join(round_dir, "obs",
-                                                   "*.jsonl")))
+        # metrics*.jsonl under obs/ are obs-metrics-v1 exports, not span
+        # logs — they have their own section (and glob below)
+        span_paths = [p for p in sorted(glob.glob(os.path.join(
+            round_dir, "obs", "*.jsonl")))
+            if not os.path.basename(p).startswith("metrics")]
     queue_dir = args.queue_dir
     if queue_dir is None:
         cand = os.path.join(round_dir, "queue")
@@ -473,8 +612,13 @@ def generate(args) -> Dict:
     if not bench_paths:
         bench_paths = sorted(glob.glob(os.path.join(round_dir,
                                                     "BENCH_*.json")))
+    metrics_paths = list(getattr(args, "metrics", None) or [])
+    if not metrics_paths:
+        metrics_paths = sorted(glob.glob(os.path.join(round_dir, "obs",
+                                                      "metrics*.jsonl")))
     rep = build_report(round_name, span_paths, queue_dir, bench_paths,
-                       list(args.loss_log or []))
+                       list(args.loss_log or []),
+                       metrics_paths=metrics_paths)
     out_dir = args.out or os.path.join(round_dir, "obs")
     os.makedirs(out_dir, exist_ok=True)
     save_json(os.path.join(out_dir, "report.json"), rep, indent=1,
@@ -540,6 +684,11 @@ def selfcheck() -> int:
                                        "to": "degraded"})
         with tracer.span("recover:reload"):
             pass
+        # SLO watchdog taxonomy (ISSUE 10): two alerts bracketing the
+        # fault above — the SLO section's join + timeline ordering
+        tracer.event("alert:serve-error-burn", frac=0.5, budget=0.1,
+                     window=2)
+        tracer.event("alert:train-step-drift", z=5.2, value=180.0)
         tracer.close()
         with open(span_path, "a") as f:  # graftlint: off=raw-artifact-write
             f.write('{"kind": "span", "torn')  # kill -9 mid-append twin
@@ -585,18 +734,37 @@ def selfcheck() -> int:
              "grad_norm": [30.0, 7.0], "update_norm": [0.8, 0.5],
              "param_norm": [49.0, 49.1]}).encode())
 
+        # live metrics export (ISSUE 10): two snapshots + a torn tail the
+        # reader must drop — the Metrics section's input
+        from real_time_helmet_detection_tpu.obs.metrics import (
+            MetricsRegistry, MetricsWriter)
+        metrics_path = os.path.join(tmp, "obs", "metrics.jsonl")
+        mreg = MetricsRegistry()
+        mreg.counter("serve.completed").inc(7)
+        mreg.gauge("queue.jobs.done").set(1)
+        for v in (10.0, 20.0, 30.0, 40.0):
+            mreg.histogram("serve.e2e_ms").observe(v)
+        mw = MetricsWriter(mreg, metrics_path, period_s=0.0)
+        mw.maybe_flush(force=True)
+        mreg.counter("serve.completed").inc(1)
+        mw.maybe_flush(force=True)
+        mw.close()
+        with open(metrics_path, "a") as f:  # graftlint: off=raw-artifact-write
+            f.write('{"schema": "obs-met')  # kill -9 mid-append twin
+
         ns = argparse.Namespace(round="rXX", span_log=[span_path],
                                 queue_dir=qdir, bench=[bench_path],
                                 loss_log=[loss_path],
+                                metrics=[metrics_path],
                                 out=os.path.join(tmp, "out"))
         rep = generate(ns)
 
         check("schema tagged", rep["schema"] == SCHEMA)
         sp = rep["spans"]
         check("torn span tail dropped, all real records read",
-              sp["records"] == 33)  # meta + 4 steps + ckpt + hb + ctx
+              sp["records"] == 35)  # meta + 4 steps + ckpt + hb + ctx
         # + 16 serve spans + shed event + 7 fault/recover events +
-        # reload span
+        # reload span + 2 alert events
         check("step span stats", sp["by_name"].get("step", {}).get(
             "count") == 4 and abs(sp["by_name"]["step"]["total_s"]
                                   - 0.1) < 1e-6)
@@ -629,6 +797,30 @@ def selfcheck() -> int:
               and flt["skipped_steps"] == 1)
         check("engine transitions joined",
               flt["engine_transitions"] == {"serving->degraded": 1})
+        mtr = rep["metrics"]
+        check("metrics section joined", mtr is not None
+              and len(mtr["files"]) == 1
+              and mtr["files"][0]["snapshots"] == 3  # 2 flushes + close
+              and mtr["files"][0]["counters"]["serve.completed"] == 8)
+        # nearest-rank over [10, 20, 30, 40] ms at histogram resolution:
+        # p50 -> the 30 ms bucket (~9% wide), p99 -> max = 40
+        check("metrics histogram digested",
+              abs(mtr["files"][0]["histograms"]["serve.e2e_ms"]["p50"]
+                  - 30.0) < 3.0
+              and mtr["files"][0]["histograms"]["serve.e2e_ms"]["max"]
+              == 40.0)
+        slo_sec = rep["slo"]
+        check("slo section joined", slo_sec is not None
+              and slo_sec["by_rule"] == {"serve-error-burn": 1,
+                                         "train-step-drift": 1}
+              and slo_sec["alert_total"] == 2)
+        tl_names = [ev["name"] for ev in slo_sec["timeline"]]
+        check("slo timeline joins faults + state transitions",
+              "fault:device-loss" in tl_names
+              and "recover:requeue" in tl_names
+              and "serve:state serving->degraded" in tl_names
+              and tl_names.index("fault:device-loss")
+              < tl_names.index("serve-error-burn"))
         q = rep["queue"]
         check("queue states joined", q is not None
               and q["jobs"]["bench"]["state"] == "done"
@@ -655,6 +847,31 @@ def selfcheck() -> int:
               "## Faults" in md and "device-loss ×1" in md
               and "rollback ×1" in md
               and "serving->degraded ×1" in md)
+        check("markdown carries metrics + slo sections",
+              "## Metrics" in md and "serve.completed=8" in md
+              and "## SLO" in md and "serve-error-burn ×1" in md)
+
+        # schema compat: the generated v2 report reads back through
+        # read_report, and a committed v1 report (a pre-ISSUE-10 round)
+        # normalizes with the new sections nulled; junk schemas refuse
+        rep_path = os.path.join(tmp, "out", "report.json")
+        back = read_report(rep_path)
+        check("v2 report readable via read_report",
+              back is not None and back["schema"] == SCHEMA
+              and back["metrics"] is not None)
+        v1_path = os.path.join(tmp, "report_v1.json")
+        atomic_write_bytes(v1_path, json.dumps(
+            {"schema": "obs-report-v1", "round": "r08",
+             "spans": {"records": 3}}).encode())
+        v1 = read_report(v1_path)
+        check("v1 report readable with v2 sections nulled",
+              v1 is not None and v1["metrics"] is None
+              and v1["slo"] is None and v1["spans"]["records"] == 3)
+        junk_path = os.path.join(tmp, "report_junk.json")
+        atomic_write_bytes(junk_path, json.dumps(
+            {"schema": "obs-report-v9"}).encode())
+        check("unknown report schema refused",
+              read_report(junk_path) is None)
 
     ok = not failures
     print(json.dumps({"tool": "obs_report", "selfcheck": True, "ok": ok,
@@ -678,6 +895,9 @@ def main(argv=None) -> int:
                         "artifacts/<round>/BENCH_*.json)")
     p.add_argument("--loss-log", action="append", default=[],
                    help="loss_log.json sidecar (v1 or v2); repeat")
+    p.add_argument("--metrics", action="append", default=[],
+                   help="obs-metrics-v1 JSONL path; repeat (default "
+                        "artifacts/<round>/obs/metrics*.jsonl)")
     p.add_argument("--out", default=None,
                    help="output dir (default artifacts/<round>/obs)")
     p.add_argument("--selfcheck", action="store_true",

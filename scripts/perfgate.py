@@ -1,0 +1,722 @@
+"""perfgate — the cross-round perf regression gate over a committed ledger.
+
+The reference has no performance tracking at all (its README quotes one
+FPS number once, ref README.md:76). This repo accumulated five rounds of
+BENCH trajectory plus per-round bench/serve-bench/roofline artifacts and
+live metric snapshots — and NOTHING machine-compared them: a 15% step-
+time or p99 regression shipped silently unless a human re-read
+CHANGES.md (ISSUE 10). perfgate closes that hole exactly the way
+graftlint closed the convention hole: a committed reference
+(`real_time_helmet_detection_tpu/analysis/perf_ledger.json`, schema
+**perf-ledger-v1**) and a ratchet gate that FAILS on any tracked metric
+regressing past its tolerance.
+
+Sources joined (all static committed files — the gate is deterministic
+and CPU-only; pure file work, no backend):
+
+* `BENCH_r*.json` (repo root)               — the driver's round-end
+  bench lines (the `parsed` object; an embedded `last_tpu` is NOT
+  re-counted — it aliases a *_local.json already scanned),
+* `artifacts/r*/BENCH_*_local.json`         — committed on-chip/CPU
+  bench lines (last line per file),
+* `artifacts/r*/serving/serve_bench*.json`  — serve-bench-v1 curves
+  (fault-injected artifacts gate separately: `+faults` key suffix),
+* `artifacts/r*/roofline/*.json`            — roofline-v1 per-op-class
+  HBM bytes (diff artifacts skipped),
+* `artifacts/r*/obs/metrics*.jsonl`         — live obs-metrics-v1
+  snapshots (latency histogram p99s), schema obs-report-v2's Metrics
+  source read the same way.
+
+Keying: every metric key embeds its config discriminators
+(platform/imsize/batch/dtype + non-default step-compression levers), so
+a bf16-epilogue step time never gates against an fp32 one and a CPU
+fallback never gates against chip numbers. Per key the CURRENT
+observation is the highest-round one; the LEDGER holds the committed
+reference. Regression = worse than the reference by more than the
+tolerance class:
+
+=========  =============================  ==========================
+class      metrics                        tolerance
+=========  =============================  ==========================
+bytes      HBM bytes per op-class/step    2% (deterministic counts)
+time       step/latency/p50/p99 ms        10% tpu / 50% cpu+live
+rate       fps, goodput, MFU, capacity    10% tpu / 50% cpu+live
+=========  =============================  ==========================
+
+(CPU wall numbers get the wide tolerance because the shared box's
+effective speed varies ~2x over hours, CLAUDE.md — the CPU gate catches
+catastrophe, the TPU gate catches regressions.)
+
+Workflow (mirrors graftlint's EMPTY-baseline ratchet):
+
+    python scripts/perfgate.py               # gate HEAD vs the ledger
+    python scripts/perfgate.py --candidate artifacts/r13/BENCH_r13_local.json
+                                             # gate ONE new artifact
+    python scripts/perfgate.py --update      # accept current as the new
+                                             # reference (worsened entries
+                                             # are listed LOUDLY first)
+    python scripts/perfgate.py --selfcheck   # seeded fixtures prove the
+                                             # gate (incl. a +20% step-time
+                                             # regression FAILING), seconds
+
+Prints ONE JSON line; exit 0 = no regression, 1 = regression (or
+selfcheck failure). Run it before calling ANY perf claim done (CLAUDE.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from real_time_helmet_detection_tpu.obs.metrics import (  # noqa: E402
+    read_metrics, snapshot_digest)
+from real_time_helmet_detection_tpu.utils import save_json  # noqa: E402
+
+SCHEMA = "perf-ledger-v1"
+LEDGER_PATH = os.path.join(REPO, "real_time_helmet_detection_tpu",
+                           "analysis", "perf_ledger.json")
+
+# direction per metric name: "higher" is better, or "lower"
+HIGHER = "higher"
+LOWER = "lower"
+
+# (direction, tolerance class) per bench-line metric
+BENCH_METRICS = {
+    "value": (HIGHER, "rate"),
+    "train_img_per_sec_chip": (HIGHER, "rate"),
+    "train_step_ms": (LOWER, "time"),
+    "step_p50_ms": (LOWER, "time"),
+    "step_p99_ms": (LOWER, "time"),
+    "latency_ms_b1": (LOWER, "time"),
+    "mfu_train": (HIGHER, "rate"),
+    "mfu_fwd": (HIGHER, "rate"),
+    "hbm_bytes_per_step": (LOWER, "bytes"),
+    "int8_fps": (HIGHER, "rate"),
+    "serve_p50_ms": (LOWER, "time"),
+    "serve_p99_ms": (LOWER, "time"),
+    "serve_goodput": (HIGHER, "rate"),
+}
+
+SERVE_METRICS = {
+    "serial_b1_rps": (HIGHER, "rate"),
+    "engine_capacity_rps": (HIGHER, "rate"),
+    "goodput_vs_serial_at_overload": (HIGHER, "rate"),
+}
+
+# live-snapshot histogram p99s worth tracking (key -> direction/class)
+LIVE_HISTS = ("serve.e2e_ms", "train.step_ms", "bench.step_ms")
+
+TOLERANCE = {
+    "bytes": {"default": 0.02},
+    "time": {"tpu": 0.10, "default": 0.50},
+    "rate": {"tpu": 0.10, "default": 0.50},
+}
+
+
+def log(msg: str) -> None:
+    print("[perfgate] %s" % msg, file=sys.stderr, flush=True)
+
+
+def tolerance_for(klass: str, platform: str) -> float:
+    t = TOLERANCE.get(klass, {"default": 0.10})
+    return t.get(platform, t["default"])
+
+
+def _round_of(path: str) -> int:
+    """rNN from anywhere in the path (-1 when unroundable: sorts first,
+    so explicitly-rounded artifacts always win the 'latest' pick)."""
+    m = re.findall(r"r(\d+)", path.replace(os.sep, "/"))
+    return int(m[-1]) if m else -1
+
+
+class Obs:
+    """One observation of one metric key."""
+
+    __slots__ = ("key", "value", "direction", "klass", "platform",
+                 "round", "source")
+
+    def __init__(self, key, value, direction, klass, platform, rnd,
+                 source):
+        self.key = key
+        self.value = float(value)
+        self.direction = direction
+        self.klass = klass
+        self.platform = platform
+        self.round = rnd
+        self.source = source
+
+    def as_dict(self) -> Dict:
+        return {"value": self.value, "direction": self.direction,
+                "class": self.klass, "platform": self.platform,
+                "round": self.round, "source": self.source}
+
+
+# ---------------------------------------------------------------------------
+# per-source extractors
+
+
+def _bench_sig(rec: Dict) -> str:
+    """Config signature for a bench line's keys: platform/imsize/batch
+    always; non-default step-compression levers only when present (so
+    historical keys stay stable as fields accrete)."""
+    parts = ["%s" % rec.get("platform", "?"),
+             "%s" % rec.get("imsize", "?"),
+             "b%s" % rec.get("batch", "?")]
+    # "xla" loss-kernel/epilogue IS the unlevered pre-PR program, so it
+    # keys identically to historical lines that predate those fields —
+    # only a genuinely different program (fused kernels, bf16 params,
+    # remat, sentinel) forks the trajectory
+    for field, defaults, tag in (
+            ("remat", ("none",), "remat"),
+            ("loss_kernel", ("auto", "xla"), "lk"),
+            ("param_policy", ("fp32",), "pp"),
+            ("epilogue", ("auto", "xla"), "epi"),
+            ("sentinel", ("off",), "sent")):
+        val = rec.get(field)
+        if val is not None and val not in defaults:
+            parts.append("%s=%s" % (tag, val))
+    return ",".join(parts)
+
+
+def obs_from_bench_line(rec: Dict, rnd: int, source: str) -> List[Obs]:
+    if not isinstance(rec, dict) or rec.get("error"):
+        return []  # a failed bench line is queue evidence, not a perf ref
+    platform = rec.get("platform") or "?"
+    sig = _bench_sig(rec)
+    out = []
+    for name, (direction, klass) in BENCH_METRICS.items():
+        val = rec.get(name)
+        if isinstance(val, (int, float)):
+            out.append(Obs("bench[%s].%s" % (sig, name), val, direction,
+                           klass, platform, rnd, source))
+    return out
+
+
+def obs_from_serve_artifact(d: Dict, rnd: int, source: str) -> List[Obs]:
+    if d.get("schema") != "serve-bench-v1":
+        return []
+    platform = d.get("platform") or "?"
+    sig = "%s,%s,%s" % (platform, d.get("imsize", "?"),
+                        d.get("infer_dtype", "?"))
+    if d.get("faults_spec") or d.get("faults"):
+        sig += ",+faults"  # fault-injected curves gate only vs each other
+    out = []
+    for name, (direction, klass) in SERVE_METRICS.items():
+        val = d.get(name)
+        if isinstance(val, (int, float)):
+            out.append(Obs("serve[%s].%s" % (sig, name), val, direction,
+                           klass, platform, rnd, source))
+    for row in d.get("curve") or []:
+        mult = row.get("load_multiplier")
+        if mult is None:
+            continue
+        if isinstance(row.get("goodput_rps"), (int, float)):
+            out.append(Obs("serve[%s].goodput@x%s" % (sig, mult),
+                           row["goodput_rps"], HIGHER, "rate", platform,
+                           rnd, source))
+        if isinstance(row.get("p99_ms"), (int, float)):
+            out.append(Obs("serve[%s].p99_ms@x%s" % (sig, mult),
+                           row["p99_ms"], LOWER, "time", platform, rnd,
+                           source))
+    return out
+
+
+def obs_from_roofline(d: Dict, rnd: int, source: str) -> List[Obs]:
+    if d.get("schema") != "roofline-v1":
+        return []  # roofline-diff-v1 etc. are derived artifacts
+    cfg = d.get("config") or {}
+    platform = d.get("platform") or "?"
+    sig = "%s,%s,b%s,pp=%s,epi=%s" % (
+        platform, cfg.get("imsize", "?"), cfg.get("batch", "?"),
+        cfg.get("param_policy", "fp32"), cfg.get("epilogue", "auto"))
+    out = []
+    summary = d.get("summary") or {}
+    total = summary.get("total_bytes")
+    if isinstance(total, (int, float)):
+        out.append(Obs("roofline[%s].total_bytes" % sig, total, LOWER,
+                       "bytes", platform, rnd, source))
+    for klass_name, row in (summary.get("by_class") or {}).items():
+        val = (row or {}).get("bytes")
+        if isinstance(val, (int, float)):
+            out.append(Obs("roofline[%s].bytes.%s" % (sig, klass_name),
+                           val, LOWER, "bytes", platform, rnd, source))
+    return out
+
+
+def obs_from_metrics_jsonl(path: str, rnd: int, source: str) -> List[Obs]:
+    snaps = [s for s in read_metrics(path)
+             if isinstance(s, dict) and s.get("schema") == "obs-metrics-v1"]
+    if not snaps:
+        return []
+    digest = snapshot_digest(snaps[-1])
+    out = []
+    for name in LIVE_HISTS:
+        h = digest["histograms"].get(name)
+        if h and isinstance(h.get("p99"), (int, float)):
+            # platform "live": snapshots carry no platform tag, so they
+            # get the wide (CPU-grade) tolerance
+            out.append(Obs("live[%s].p99" % name, h["p99"], LOWER, "time",
+                           "live", rnd, source))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# repo scan -> observations -> current picks
+
+
+def scan_observations(root: str) -> List[Obs]:
+    out: List[Obs] = []
+
+    def rel(p):
+        return os.path.relpath(p, root)
+
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = d.get("parsed") if isinstance(d, dict) else None
+        if isinstance(parsed, dict):
+            out += obs_from_bench_line(parsed, _round_of(path), rel(path))
+    for path in sorted(glob.glob(os.path.join(
+            root, "artifacts", "*", "BENCH_*_local.json"))):
+        try:
+            with open(path) as f:
+                lines = [ln for ln in f.read().splitlines() if ln.strip()]
+            rec = json.loads(lines[-1])
+        except (OSError, json.JSONDecodeError, IndexError):
+            continue
+        out += obs_from_bench_line(rec, _round_of(path), rel(path))
+    for path in sorted(glob.glob(os.path.join(
+            root, "artifacts", "*", "serving", "serve_bench*.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        out += obs_from_serve_artifact(d, _round_of(path), rel(path))
+    for path in sorted(glob.glob(os.path.join(
+            root, "artifacts", "*", "roofline", "*.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        out += obs_from_roofline(d, _round_of(path), rel(path))
+    for path in sorted(glob.glob(os.path.join(
+            root, "artifacts", "*", "obs", "metrics*.jsonl"))):
+        out += obs_from_metrics_jsonl(path, _round_of(path), rel(path))
+    return out
+
+
+def pick_current(observations: List[Obs]) -> Dict[str, Obs]:
+    """Per key, the highest-round observation; same-round ties go to the
+    BETTER value (deterministic, and a rerun in one round can only
+    improve the reference)."""
+    best: Dict[str, Obs] = {}
+    for ob in observations:
+        cur = best.get(ob.key)
+        if cur is None or ob.round > cur.round:
+            best[ob.key] = ob
+        elif ob.round == cur.round:
+            better = (ob.value > cur.value if ob.direction == HIGHER
+                      else ob.value < cur.value)
+            if better:
+                best[ob.key] = ob
+    return best
+
+
+def history_of(observations: List[Obs]) -> Dict[str, List[Dict]]:
+    hist: Dict[str, List[Dict]] = {}
+    for ob in sorted(observations, key=lambda o: (o.key, o.round,
+                                                  o.source)):
+        hist.setdefault(ob.key, []).append(
+            {"round": ob.round, "value": ob.value, "source": ob.source})
+    return hist
+
+
+# ---------------------------------------------------------------------------
+# ledger + gate
+
+
+def load_ledger(path: Optional[str] = None) -> Optional[Dict]:
+    path = path or LEDGER_PATH
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if d.get("schema") != SCHEMA:
+        log("unreadable ledger schema %r in %s" % (d.get("schema"), path))
+        return None
+    return d
+
+
+def write_ledger(current: Dict[str, Obs],
+                 observations: List[Obs],
+                 path: Optional[str] = None) -> str:
+    path = path or LEDGER_PATH
+    entries = {k: ob.as_dict() for k, ob in sorted(current.items())}
+    save_json(path, {"schema": SCHEMA, "v": 1,
+                     "generated_at_round": max(
+                         [ob.round for ob in current.values()],
+                         default=-1),
+                     "entries": entries,
+                     "history": history_of(observations)},
+              indent=1, sort_keys=True)
+    return path
+
+
+def gate(current: Dict[str, Obs], ledger: Dict) -> Dict:
+    """The ratchet: every key present in BOTH the ledger and the current
+    scan must not be worse than the committed reference by more than its
+    tolerance. New keys are untracked (pass; --update adopts them);
+    ledger keys with no current observation are stale (pass, listed)."""
+    entries = ledger.get("entries") or {}
+    regressions, checked, improved = [], 0, 0
+    untracked = sorted(k for k in current if k not in entries)
+    stale = sorted(k for k in entries if k not in current)
+    for key, ref in sorted(entries.items()):
+        ob = current.get(key)
+        if ob is None:
+            continue
+        checked += 1
+        tol = tolerance_for(ref.get("class", "rate"),
+                            ref.get("platform", "default"))
+        ref_v = float(ref["value"])
+        if ref.get("direction", HIGHER) == HIGHER:
+            bad = ob.value < ref_v * (1.0 - tol)
+            better = ob.value > ref_v
+        else:
+            bad = ob.value > ref_v * (1.0 + tol)
+            better = ob.value < ref_v
+        if bad:
+            regressions.append({
+                "key": key, "reference": ref_v, "current": ob.value,
+                "delta_pct": round(100.0 * (ob.value - ref_v)
+                                   / max(abs(ref_v), 1e-12), 2),
+                "tolerance_pct": round(100.0 * tol, 1),
+                "direction": ref.get("direction"),
+                "source": ob.source})
+        elif better:
+            improved += 1
+    return {"checked": checked, "regressions": regressions,
+            "improved": improved, "untracked": untracked, "stale": stale}
+
+
+def candidate_observations(path: str) -> List[Obs]:
+    """Observations from ONE artifact being gated before commit: a bench
+    JSON-line file, a serve-bench artifact, a roofline artifact, or a
+    metrics JSONL — sniffed by shape, keyed identically to the scan so
+    the ledger lookup just works."""
+    rnd = _round_of(path)
+    if path.endswith(".jsonl"):
+        return obs_from_metrics_jsonl(path, rnd, path)
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        d = json.loads(lines[-1])
+    except (OSError, json.JSONDecodeError, IndexError):
+        raise SystemExit("--candidate: unreadable artifact %s" % path)
+    if d.get("schema") == "serve-bench-v1":
+        return obs_from_serve_artifact(d, rnd, path)
+    if d.get("schema") == "roofline-v1":
+        return obs_from_roofline(d, rnd, path)
+    if isinstance(d.get("parsed"), dict):
+        d = d["parsed"]
+    return obs_from_bench_line(d, rnd, path)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def run_gate(args) -> int:
+    t0 = time.time()
+    root = args.root or REPO
+    ledger_path = args.ledger or LEDGER_PATH
+    observations = scan_observations(root)
+    current = pick_current(observations)
+    log("scanned %d observation(s) over %d metric key(s)"
+        % (len(observations), len(current)))
+
+    if args.candidate:
+        cand = pick_current(candidate_observations(args.candidate))
+        if not cand:
+            raise SystemExit("--candidate: no recognizable metrics in %s"
+                             % args.candidate)
+        log("candidate %s: %d metric key(s)" % (args.candidate, len(cand)))
+        current = cand
+
+    ledger = load_ledger(ledger_path)
+    if args.update:
+        if args.candidate:
+            raise SystemExit("--update gates the repo scan; it cannot "
+                             "adopt a --candidate (commit the artifact "
+                             "first)")
+        if ledger is not None:
+            # accepting a worse reference must be LOUD, never silent
+            d = gate(current, ledger)
+            for r in d["regressions"]:
+                log("WORSENED (accepting into ledger): %s %s -> %s "
+                    "(%+.1f%%)" % (r["key"], r["reference"], r["current"],
+                                   r["delta_pct"]))
+        path = write_ledger(current, observations, ledger_path)
+        log("ledger rewritten -> %s (%d entries)" % (path, len(current)))
+        ledger = load_ledger(ledger_path)
+
+    if ledger is None:
+        # no committed ledger: like graftlint with no baseline file —
+        # nothing is grandfathered, but nothing can gate either
+        print(json.dumps({"tool": "perfgate", "ok": True, "checked": 0,
+                          "regressions": [], "untracked": len(current),
+                          "stale": 0, "ledger": None,
+                          "note": "no ledger committed; run --update",
+                          "elapsed_s": round(time.time() - t0, 1)}))
+        sys.stdout.flush()
+        return 0
+
+    d = gate(current, ledger)
+    for r in d["regressions"]:
+        log("REGRESSION %s: %s -> %s (%+.1f%% vs ±%.1f%% tol) [%s]"
+            % (r["key"], r["reference"], r["current"], r["delta_pct"],
+               r["tolerance_pct"], r["source"]))
+    for k in d["stale"][:10]:
+        log("stale ledger key (no current observation): %s" % k)
+    ok = not d["regressions"]
+    print(json.dumps({
+        "tool": "perfgate", "ok": ok, "checked": d["checked"],
+        "regressions": d["regressions"], "improved": d["improved"],
+        "untracked": len(d["untracked"]), "stale": len(d["stale"]),
+        "ledger": os.path.relpath(ledger_path, root)
+        if ledger_path.startswith(root) else ledger_path,
+        "candidate": args.candidate,
+        "elapsed_s": round(time.time() - t0, 1)}))
+    sys.stdout.flush()
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# selfcheck: the gate proven on seeded fixtures (CI smoke tier, seconds)
+
+
+def _fixture_tree(tmp: str) -> None:
+    """A miniature two-round repo: r01 slower than r02 on chip, plus a
+    serve curve, a roofline byte table and a live metrics export."""
+    from real_time_helmet_detection_tpu.obs.metrics import (
+        MetricsRegistry, MetricsWriter)
+
+    def jline(path, rec):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        save_json(path, rec)
+
+    def jlinefile(path, rec):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        from real_time_helmet_detection_tpu.utils import atomic_write_bytes
+        atomic_write_bytes(path, (json.dumps(rec) + "\n").encode())
+
+    tpu = {"platform": "tpu", "metric": "inference_fps_512",
+           "imsize": 512, "batch": 16}
+    jlinefile(os.path.join(tmp, "artifacts", "r01",
+                           "BENCH_r01_local.json"),
+              dict(tpu, value=1100.0, train_step_ms=40.0,
+                   step_p99_ms=42.0, mfu_train=0.48,
+                   hbm_bytes_per_step=2.0e9))
+    jlinefile(os.path.join(tmp, "artifacts", "r02",
+                           "BENCH_r02_local.json"),
+              dict(tpu, value=1207.7, train_step_ms=36.8,
+                   step_p99_ms=38.5, mfu_train=0.53,
+                   hbm_bytes_per_step=1.8e9))
+    # a CPU fallback line: must key separately from the chip lines
+    jlinefile(os.path.join(tmp, "BENCH_r02.json"),
+              {"n": 2, "rc": 0,
+               "parsed": {"platform": "cpu", "imsize": 128, "batch": 2,
+                          "value": 18.0, "train_step_ms": 3000.0}})
+    jline(os.path.join(tmp, "artifacts", "r02", "serving",
+                       "serve_bench.json"),
+          {"schema": "serve-bench-v1", "platform": "tpu", "imsize": 512,
+           "infer_dtype": "int8", "serial_b1_rps": 600.0,
+           "engine_capacity_rps": 1500.0,
+           "goodput_vs_serial_at_overload": 8.0,
+           "curve": [{"load_multiplier": 2.0, "goodput_rps": 1400.0,
+                      "p99_ms": 90.0}]})
+    jline(os.path.join(tmp, "artifacts", "r02", "roofline",
+                       "roofline_tpu.json"),
+          {"schema": "roofline-v1", "platform": "tpu",
+           "config": {"batch": 16, "imsize": 512,
+                      "param_policy": "fp32", "epilogue": "auto"},
+           "summary": {"total_bytes": 1.0e11,
+                       "by_class": {"conv": {"bytes": 2.0e10},
+                                    "convert": {"bytes": 3.0e10}}}})
+    mreg = MetricsRegistry()
+    for v in (5.0, 6.0, 7.0, 50.0):
+        mreg.histogram("serve.e2e_ms").observe(v)
+    mpath = os.path.join(tmp, "artifacts", "r02", "obs", "metrics.jsonl")
+    os.makedirs(os.path.dirname(mpath), exist_ok=True)
+    mw = MetricsWriter(mreg, mpath, period_s=0.0)
+    mw.close()
+
+
+def selfcheck() -> int:
+    import tempfile
+    t0 = time.time()
+    failures: List[str] = []
+
+    def check(name, cond):
+        print("selfcheck %-52s %s" % (name, "ok" if cond else "FAIL"),
+              file=sys.stderr, flush=True)
+        if not cond:
+            failures.append(name)
+
+    def run(argv):
+        class _Ns:
+            pass
+        p_args = parse_args(argv)
+        try:
+            rc = run_gate(p_args)
+        except SystemExit as e:
+            rc = e.code if isinstance(e.code, int) else 1
+        return rc
+
+    with tempfile.TemporaryDirectory(prefix="perfgate_selfcheck.") as tmp:
+        _fixture_tree(tmp)
+        ledger = os.path.join(tmp, "perf_ledger.json")
+
+        # ungated repo: passes with a note, nothing grandfathered
+        check("no ledger -> pass (nothing to gate)",
+              run(["--root", tmp, "--ledger", ledger]) == 0)
+        # build the ledger, then the same tree must gate clean (the
+        # at-HEAD acceptance property, proven on the fixture)
+        check("--update writes the ledger",
+              run(["--root", tmp, "--ledger", ledger, "--update"]) == 0
+              and load_ledger(ledger) is not None)
+        led = load_ledger(ledger)
+        check("ledger picked the latest round per key",
+              led["entries"]["bench[tpu,512,b16].train_step_ms"]["value"]
+              == 36.8
+              and led["entries"]["bench[tpu,512,b16].value"]["value"]
+              == 1207.7)
+        check("cpu line keyed separately from chip",
+              "bench[cpu,128,b2].train_step_ms" in led["entries"])
+        check("ledger carries the cross-round history",
+              [h["value"] for h in
+               led["history"]["bench[tpu,512,b16].train_step_ms"]]
+              == [40.0, 36.8])
+        check("same tree gates clean vs its own ledger",
+              run(["--root", tmp, "--ledger", ledger]) == 0)
+
+        # the acceptance fixture: +20% step time on chip must FAIL
+        bad = os.path.join(tmp, "cand_bad.json")
+        save_json(bad, {"platform": "tpu", "imsize": 512, "batch": 16,
+                        "value": 1210.0, "train_step_ms": 36.8 * 1.2})
+        check("+20% tpu step time FAILS the gate",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", bad]) == 1)
+        # +5% bytes beats the 2% determinism tolerance -> FAIL
+        badb = os.path.join(tmp, "cand_bytes.json")
+        save_json(badb, {"schema": "roofline-v1", "platform": "tpu",
+                         "config": {"batch": 16, "imsize": 512,
+                                    "param_policy": "fp32",
+                                    "epilogue": "auto"},
+                         "summary": {"total_bytes": 1.0e11,
+                                     "by_class": {"conv":
+                                                  {"bytes": 2.1e10}}}})
+        check("+5% conv bytes FAILS the gate",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", badb]) == 1)
+        # serve p99 doubling at the overload point -> FAIL
+        bads = os.path.join(tmp, "cand_serve.json")
+        save_json(bads, {"schema": "serve-bench-v1", "platform": "tpu",
+                         "imsize": 512, "infer_dtype": "int8",
+                         "engine_capacity_rps": 1480.0,
+                         "curve": [{"load_multiplier": 2.0,
+                                    "goodput_rps": 1380.0,
+                                    "p99_ms": 180.0}]})
+        check("2x serve p99 FAILS the gate",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", bads]) == 1)
+        # within-tolerance chip wiggle and a 30%-slow CPU line both pass
+        okc = os.path.join(tmp, "cand_ok.json")
+        save_json(okc, {"platform": "tpu", "imsize": 512, "batch": 16,
+                        "value": 1180.0, "train_step_ms": 37.9})
+        check("within-tolerance chip wiggle passes",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", okc]) == 0)
+        okcpu = os.path.join(tmp, "cand_cpu.json")
+        save_json(okcpu, {"platform": "cpu", "imsize": 128, "batch": 2,
+                          "value": 14.0, "train_step_ms": 3900.0})
+        check("30%-slow cpu line passes (box-noise tolerance)",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", okcpu]) == 0)
+        # an untracked config is informational, never a regression
+        okn = os.path.join(tmp, "cand_new.json")
+        save_json(okn, {"platform": "tpu", "imsize": 768, "batch": 32,
+                        "value": 900.0, "train_step_ms": 80.0})
+        check("untracked config passes as untracked",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", okn]) == 0)
+        # improvement then --update ratchets the reference forward
+        imp = os.path.join(tmp, "artifacts", "r03",
+                           "BENCH_r03_local.json")
+        os.makedirs(os.path.dirname(imp), exist_ok=True)
+        from real_time_helmet_detection_tpu.utils import atomic_write_bytes
+        atomic_write_bytes(imp, (json.dumps(
+            {"platform": "tpu", "metric": "inference_fps_512",
+             "imsize": 512, "batch": 16, "value": 1300.0,
+             "train_step_ms": 33.0}) + "\n").encode())
+        check("improved round gates clean",
+              run(["--root", tmp, "--ledger", ledger]) == 0)
+        check("--update ratchets to the improvement",
+              run(["--root", tmp, "--ledger", ledger, "--update"]) == 0
+              and load_ledger(ledger)["entries"][
+                  "bench[tpu,512,b16].train_step_ms"]["value"] == 33.0)
+        # live metrics snapshots are tracked too
+        check("live histogram p99 tracked",
+              "live[serve.e2e_ms].p99"
+              in load_ledger(ledger)["entries"])
+
+    ok = not failures
+    print(json.dumps({"tool": "perfgate", "selfcheck": True, "ok": ok,
+                      "failures": failures,
+                      "elapsed_s": round(time.time() - t0, 1)}))
+    sys.stdout.flush()
+    return 0 if ok else 1
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--root", default=None,
+                   help="repo root to scan (default: this repo)")
+    p.add_argument("--ledger", default=None,
+                   help="ledger path (default analysis/perf_ledger.json)")
+    p.add_argument("--candidate", default=None,
+                   help="gate ONE artifact (bench line / serve-bench / "
+                        "roofline / metrics JSONL) against the ledger "
+                        "instead of rescanning the repo")
+    p.add_argument("--update", action="store_true",
+                   help="rewrite the ledger from the current scan "
+                        "(worsened entries are listed loudly)")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="prove the gate on seeded fixtures, then exit")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.selfcheck:
+        return selfcheck()
+    return run_gate(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

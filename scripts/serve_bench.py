@@ -35,11 +35,23 @@ for END-TO-END request latency because the result fetch is a real D2H.
   retried/requeued). The selfcheck pins `lost == 0` under the canned
   schedule — in-flight recovery keeps every acknowledged request.
 
+* **live metrics + SLO (ISSUE 10)** — the engine runs with its own
+  `obs.metrics` registry and an `obs.slo` watchdog (error-burn always,
+  e2e latency-burn against the goodput deadline): the artifact carries
+  the FINAL registry snapshot (`metrics`, schema obs-metrics-v1) and the
+  alert list, and the ONE JSON line carries the shed/retry/fill
+  aggregates — the same numbers a fleet dashboard would scrape, pinned
+  by `--selfcheck` to agree with the engine's own stats rows. Latency
+  digests (p50/p99) come from the fixed-layout metrics histogram, not
+  hand-rolled percentile arithmetic (graftlint
+  ast/raw-metric-aggregation; bucket resolution ~9% is the documented
+  precision of these fields).
+
 Artifact: `artifacts/<round>/serving/serve_bench.json`, schema
 **serve-bench-v1**, atomic write; ONE JSON line on stdout (repo
 convention). `--selfcheck` proves the engine contract (bit-identity vs
 one-shot predict, shed paths, zero recompiles, zero lost acks under
-faults) on seeded CPU load in ~a minute.
+faults, metrics/stats agreement) on seeded CPU load in ~a minute.
 """
 
 from __future__ import annotations
@@ -50,7 +62,7 @@ import os
 import sys
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 
@@ -58,6 +70,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from bench import acquire_backend, graft_round  # noqa: E402
+from real_time_helmet_detection_tpu.obs.metrics import (  # noqa: E402
+    Histogram, MetricsRegistry)
+from real_time_helmet_detection_tpu.obs.slo import (  # noqa: E402
+    SloWatchdog, default_serving_rules)
 from real_time_helmet_detection_tpu.runtime import (  # noqa: E402
     ChaosInjector, FaultSchedule, maybe_injector, maybe_job_heartbeat,
     run_as_job)
@@ -72,18 +88,20 @@ def log(msg: str) -> None:
     print("[serve_bench] %s" % msg, file=sys.stderr, flush=True)
 
 
-def _pctl(vals: List[float], q: float) -> Optional[float]:
-    if not vals:
-        return None
-    s = sorted(vals)
-    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
-
-
 def _lat_ms(vals: List[float]) -> Dict:
-    return {"p50_ms": (round(_pctl(vals, 0.50) * 1e3, 2) if vals else None),
-            "p99_ms": (round(_pctl(vals, 0.99) * 1e3, 2) if vals else None),
-            "mean_ms": (round(sum(vals) / len(vals) * 1e3, 2)
-                        if vals else None)}
+    """p50/p99/mean over host latencies (seconds in, ms out) via the
+    obs.metrics fixed-layout histogram — the metrics plane's OWN digest
+    path, not hand-rolled percentile arithmetic (graftlint
+    ast/raw-metric-aggregation); means are exact, quantiles carry the
+    histogram's ~9% bucket resolution."""
+    if not vals:
+        return {"p50_ms": None, "p99_ms": None, "mean_ms": None}
+    h = Histogram("lat_ms")
+    for v in vals:
+        h.observe(v * 1e3)
+    return {"p50_ms": round(h.quantile(0.50), 2),
+            "p99_ms": round(h.quantile(0.99), 2),
+            "mean_ms": round(h.mean, 2)}
 
 
 def arrival_schedule(rate_rps: float, duration_s: float,
@@ -309,6 +327,13 @@ def run_bench(args) -> Dict:
     if injector is not None:
         out["faults_spec"] = injector.schedule.spec()
         log("fault injection armed: %s" % out["faults_spec"])
+    # live metrics plane + SLO watchdog (ISSUE 10): a FRESH registry per
+    # run (the artifact's snapshot is this run's evidence alone); the
+    # watchdog's burn rules run against it and its alerts land in the
+    # span log + the artifact
+    mreg = MetricsRegistry()
+    slo = SloWatchdog(default_serving_rules(deadline_ms=args.deadline_ms),
+                      registry=mreg, tracer=tracer)
     engine = ServingEngine(predict, variables,
                            (args.imsize, args.imsize, 3), np.uint8,
                            buckets=args.buckets,
@@ -318,7 +343,7 @@ def run_bench(args) -> Dict:
                            hang_timeout_s=(args.hang_timeout_ms / 1e3
                                            if args.hang_timeout_ms > 0
                                            else None),
-                           injector=injector)
+                           injector=injector, metrics=mreg, watchdog=slo)
     try:
         # closed loop: engine saturation capacity
         warm = engine.predict_many(pool[:min(4, len(pool))])
@@ -366,6 +391,21 @@ def run_bench(args) -> Dict:
                    out["faults"]["retried"], out["faults"]["lost_acks"]))
     finally:
         engine.close()
+
+    # the final metrics snapshot rides the artifact (ISSUE 10 satellite),
+    # and the fleet-dashboard aggregates ride the ONE JSON line — pinned
+    # by --selfcheck to agree with the engine's own stats
+    st = engine.stats()
+    out["metrics"] = mreg.snapshot()
+    out["shed_total"] = st["shed_queue_full"] + st["shed_deadline"]
+    out["retried"] = st["retried"]
+    slots = mreg.counter("serve.batch_slots").value
+    out["mean_batch_fill"] = (round(1.0 - st["padded_slots"] / slots, 3)
+                              if slots else None)
+    out["slo_alerts"] = [a["rule"] for a in slo.alerts]
+    log("metrics: shed %d, retried %d, mean fill %s, alerts %s"
+        % (out["shed_total"], out["retried"], out["mean_batch_fill"],
+           out["slo_alerts"] or "none"))
 
     # serial baseline under the SAME past-saturation arrival trace
     over = max(args.loads)
@@ -433,9 +473,11 @@ def selfcheck() -> int:
     with tempfile.TemporaryDirectory(prefix="serve_bench_selfcheck.") as tmp:
         span_path = os.path.join(tmp, "spans.jsonl")
         tracer = maybe_tracer(span_path)
+        mreg = MetricsRegistry()
         engine = ServingEngine(predict, variables, (64, 64, 3), np.uint8,
                                buckets=(1, 2, 4), max_wait_ms=2.0,
-                               depth=2, queue_capacity=32, tracer=tracer)
+                               depth=2, queue_capacity=32, tracer=tracer,
+                               metrics=mreg)
         # warm every bucket, then pin zero recompiles over a random stream
         engine.predict_many(pool[:4])
         counter = install_recompile_counter()
@@ -456,7 +498,28 @@ def selfcheck() -> int:
         st = engine.stats()
         check("engine served the stream",  # + the 4 warmup requests
               st["completed"] == len(rows) + 4 and st["batches"] >= 1)
+        # ISSUE 10: the live metrics snapshot must AGREE with the stats
+        # rows (one truth, two surfaces) and the e2e histogram must have
+        # absorbed exactly the completed requests. Snapshot AFTER close:
+        # a future resolves before the fetch loop's e2e observe, so an
+        # un-joined engine could still be mid-bookkeeping
         engine.close()
+        snap = mreg.snapshot()
+        check("metrics snapshot agrees with stats rows",
+              snap["counters"]["serve.submitted"] == st["submitted"]
+              and snap["counters"]["serve.completed"] == st["completed"]
+              and snap["counters"]["serve.batches_total"] == st["batches"]
+              and snap["counters"]["serve.padded_slots"]
+              == st["padded_slots"])
+        check("metrics e2e histogram absorbed the stream",
+              snap["histograms"]["serve.e2e_ms"]["count"]
+              == st["completed"])
+        hl = engine.health()
+        check("health() carries the metrics digest",
+              hl["metrics"]["histograms"]["serve.e2e_ms"]["count"]
+              == st["completed"]
+              and hl["metrics"]["counters"]["serve.completed"]
+              == st["completed"])
 
         # admission control: paused engine, tiny queue -> immediate shed
         eng2 = ServingEngine(predict, variables, (64, 64, 3), np.uint8,
@@ -518,11 +581,13 @@ def selfcheck() -> int:
                   "serve:fetch=hung-fetch@4,"
                   "serve:dispatch=device-loss@6")
         inj = ChaosInjector(FaultSchedule.parse(canned))
+        reg4 = MetricsRegistry()
+        slo4 = SloWatchdog(default_serving_rules(), registry=reg4)
         eng4 = ServingEngine(predict, variables, (64, 64, 3), np.uint8,
                              buckets=(1, 2, 4), max_wait_ms=2.0, depth=2,
                              queue_capacity=64,
                              max_retries=3, hang_timeout_s=0.1,
-                             injector=inj)
+                             injector=inj, metrics=reg4, watchdog=slo4)
         futs4 = [(int(i), eng4.submit(pool[int(i)]))
                  for i in np.random.default_rng(5).integers(0, len(pool),
                                                             24)]
@@ -548,10 +613,30 @@ def selfcheck() -> int:
         check("faults: recovery accounted",
               st4["retried"] >= 1 and st4["requeued_batches"] >= 2
               and st4["hung_batches"] == 1)
+        # ISSUE 10: the retry/requeue counters on the metrics plane agree
+        # with the stats rows even mid-chaos, and the injected batch
+        # failures fired the SLO error-burn rule deterministically
+        snap4 = reg4.snapshot()
+        check("faults: metrics snapshot agrees with stats rows",
+              snap4["counters"]["serve.retried"] == st4["retried"]
+              and snap4["counters"]["serve.requeued_batches"]
+              == st4["requeued_batches"]
+              and snap4["counters"]["serve.hung_batches"]
+              == st4["hung_batches"]
+              and snap4["counters"]["serve.failed_batches"]
+              == st4["failed_batches"])
+        check("faults: SLO error-burn alerted",
+              any(a["rule"] == "serve-error-burn" for a in slo4.alerts))
         art = os.path.join(tmp, "serve_bench.json")
-        save_json(art, {"schema": SCHEMA, "curve": [row]}, indent=1)
+        save_json(art, {"schema": SCHEMA, "curve": [row],
+                        "metrics": snap4}, indent=1)
         with open(art) as f:
-            check("artifact roundtrips", json.load(f)["schema"] == SCHEMA)
+            back = json.load(f)
+        check("artifact roundtrips", back["schema"] == SCHEMA)
+        check("metrics snapshot rides the artifact",
+              back["metrics"]["schema"] == "obs-metrics-v1"
+              and back["metrics"]["counters"]["serve.retried"]
+              == st4["retried"])
 
     ok = not failures
     print(json.dumps({"tool": "serve_bench", "selfcheck": True, "ok": ok,

@@ -450,3 +450,50 @@ def test_find_last_tpu_result_old_lines_lack_sentinel_keys(tmp_path):
     got = bench.find_last_tpu_result(root)
     assert "sentinel" not in got and "skipped_steps" not in got
     assert got["value"] == 1100.0
+
+
+def test_find_last_tpu_result_carries_step_percentile_fields(tmp_path):
+    """ISSUE 10 satellite: step_p50_ms/step_p99_ms (the live metrics
+    histogram's digest of the chained timed dispatches) ride
+    find_last_tpu_result; the pre-existing contract is untouched and
+    old lines without the keys resolve as before."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r12", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1250.0,
+        "mfu_train": 0.61, "train_step_ms": 36.2, "step_p50_ms": 36.9,
+        "step_p99_ms": 39.4})
+    got = bench.find_last_tpu_result(root)
+    assert got["step_p50_ms"] == 36.9
+    assert got["step_p99_ms"] == 39.4
+    assert got["value"] == 1250.0 and got["mfu_train"] == 0.61
+
+
+def test_find_last_tpu_result_old_lines_lack_step_percentiles(tmp_path):
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r11", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1100.0})
+    got = bench.find_last_tpu_result(root)
+    assert "step_p50_ms" not in got and "step_p99_ms" not in got
+    assert got["value"] == 1100.0
+
+
+def test_chained_scan_step_samples_threads_donated_state():
+    """The bench train-timing helper (ISSUE 10): each dispatch's
+    returned state feeds the next donated input (no deleted-buffer
+    touch), per-dispatch samples are positive with the overhead
+    subtracted and clamped, and the chained program really ran
+    (state advanced chunks times)."""
+    def prog(state, x):
+        new = state + jnp.sum(x) * 0 + 1.0
+        return new, jnp.sum(new)
+
+    compiled = jax.jit(prog, donate_argnums=(0,)).lower(
+        jnp.float32(0.0), jnp.ones((8, 8))).compile()
+    samples, final = bench.chained_scan_step_samples(
+        compiled, jnp.float32(0.0), (jnp.ones((8, 8)),), overhead=0.0,
+        chunks=3)
+    assert len(samples) == 3 and all(s > 0 for s in samples)
+    assert float(np.asarray(final)) == 3.0  # state threaded, not rebuilt
+    clamped, _ = bench.chained_scan_step_samples(
+        compiled, final, (jnp.ones((8, 8)),), overhead=1e9, chunks=1)
+    assert clamped == [1e-9]

@@ -81,6 +81,26 @@ AST_CASES = [
     ("ast/missing-ref-citation", "scripts/x.py",
      '"""Module with no provenance statement whatsoever."""\nX = 1\n',
      '"""Module citing ref evaluate.py:15 properly."""\nX = 1\n'),
+    ("ast/raw-metric-aggregation", "scripts/x.py",
+     # hand-rolled nearest-rank percentile + np.percentile in a module
+     # that acquires a backend (ISSUE 10 satellite)
+     "import numpy as np, jax\n"
+     "jax.devices()\n"
+     "def pctl(vals, q):\n"
+     "    s = sorted(vals)\n"
+     "    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]\n"
+     "def digest(lats):\n"
+     "    return {'p50': pctl(lats, 0.5),\n"
+     "            'p99': float(np.percentile(lats, 99))}\n",
+     # routed through the metrics plane instead
+     "import jax\n"
+     "from real_time_helmet_detection_tpu.obs.metrics import Histogram\n"
+     "jax.devices()\n"
+     "def digest(lats):\n"
+     "    h = Histogram('lat_ms')\n"
+     "    for v in lats:\n"
+     "        h.observe(v)\n"
+     "    return {'p50': h.quantile(0.5), 'p99': h.quantile(0.99)}\n"),
     ("ast/unbounded-retry", "scripts/x.py",
      # the r2 probe-kill class: swallow + loop forever, no cap, no pause
      "import jax\n"
@@ -166,6 +186,39 @@ def test_unbounded_retry_repo_is_clean():
     findings = [f for f in ast_rules.lint_repo(REPO)
                 if f.rule == "ast/unbounded-retry"]
     assert findings == []
+
+
+def test_raw_metric_aggregation_scope_and_allowlist():
+    """ISSUE 10 satellite: the rule only polices chip-path scripts that
+    acquire a backend (obs_report's file-work percentiles stay legal),
+    Histogram.quantile() never flags itself, and the sanctioned
+    dispatch-overhead median in bench.py is allowlisted."""
+    bad = ("import numpy as np\n"
+           "def digest(lats):\n"
+           "    return float(np.percentile(lats, 99))\n")
+    # no backend acquisition -> out of scope even under scripts/
+    assert "ast/raw-metric-aggregation" not in rules_of(
+        ast_rules.lint_source(bad, "scripts/x.py"))
+    # library modules -> out of scope regardless
+    assert "ast/raw-metric-aggregation" not in rules_of(
+        ast_rules.lint_source("import jax\njax.devices()\n" + bad,
+                              "real_time_helmet_detection_tpu/x.py"))
+    # the metrics plane's own digest is not "raw aggregation"
+    ok = ("import jax\njax.devices()\n"
+          "def digest(h):\n"
+          "    return {'p50': h.quantile(0.5)}\n")
+    assert "ast/raw-metric-aggregation" not in rules_of(
+        ast_rules.lint_source(ok, "scripts/x.py"))
+    # bench.py at HEAD is clean (measure_dispatch_overhead allowlisted)
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    assert "ast/raw-metric-aggregation" not in rules_of(
+        ast_rules.lint_source(src, "bench.py"))
+    # serve_bench at HEAD is FIXED, not grandfathered
+    with open(os.path.join(REPO, "scripts", "serve_bench.py")) as f:
+        src = f.read()
+    assert "ast/raw-metric-aggregation" not in rules_of(
+        ast_rules.lint_source(src, "scripts/serve_bench.py"))
 
 
 def test_inline_suppression_and_syntax_error():
