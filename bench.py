@@ -79,11 +79,11 @@ DEFAULT_HBM = 819e9  # v5e
 # and runner_drive.py (they diverged in r5: mfu_breakdown defaulted to r05
 # while the rest stayed at r04, scattering same-round artifacts — ADVICE
 # r5 #3); bump it here when a new round starts, or override per-run with
-# $GRAFT_ROUND. r12 = the live-metrics round (ISSUE 10: obs.metrics
-# plane, SLO watchdog, scripts/perfgate.py regression gate); earlier
-# rounds' artifact dirs are committed history and must not be
-# overwritten.
-GRAFT_ROUND_DEFAULT = "r12"
+# $GRAFT_ROUND. r13 = the data-parallel scale-out round (ISSUE 11:
+# multi-process pjit training, barrier law, the rebuilt scaling.py
+# curves); earlier rounds' artifact dirs are committed history and must
+# not be overwritten.
+GRAFT_ROUND_DEFAULT = "r13"
 
 # v5e int8 MXU peak (2x the bf16 peak — jax-ml scaling-book): the
 # denominator for int8-path MFU and the hardware case for --infer-dtype
@@ -241,7 +241,8 @@ def find_last_tpu_result(repo_root: str | None = None) -> dict | None:
             "peak_xla_us", "pallas_matches_xla", "infer_dtype", "int8_fps",
             "int8_vs_bf16", "recompile_count", "loadavg", "param_policy",
             "epilogue", "serve_p50_ms", "serve_p99_ms", "serve_goodput",
-            "sentinel", "skipped_steps", "step_p50_ms", "step_p99_ms")
+            "sentinel", "skipped_steps", "step_p50_ms", "step_p99_ms",
+            "device_count", "mesh_shape")
     out.update({k: rec[k] for k in keep if k in rec})
     return out
 
@@ -394,6 +395,13 @@ def _bench(out: dict, hb) -> None:
     on_tpu = platform == "tpu"
     log("backend up: %d x %s (%s)" % (len(devs), device_kind, platform))
     hb.beat("backend up (%s)" % platform)
+    # ISSUE 11 satellite: the line says what hardware was VISIBLE and what
+    # mesh the timed programs actually spanned — bench's programs are
+    # deliberately single-device (scaling.py owns the multi-device curves),
+    # so a chip line from a pod slice can't be misread as whole-slice
+    # throughput.
+    out["device_count"] = len(devs)
+    out["mesh_shape"] = {"data": 1, "spatial": 1}
 
     # Flight recorder (ISSUE 6): span tracing when $OBS_SPAN_LOG is set
     # (the job supervisor exports it per round), a recompile counter

@@ -64,6 +64,18 @@ analogue is manual code review, ref /root/reference/README.md:1):
                           and perfgate consume). The sanctioned bench
                           timing harness (median-of-dispatch-overheads)
                           is allowlisted.
+* `unbarriered-collective-start` — a multi-process entry point (calls
+                          `jax.distributed.initialize` /
+                          `init_process_group` / `init_distributed`) that
+                          AOT-compiles a program (`.lower(...).compile()`)
+                          without the barrier law: every compiled
+                          multi-process program creates a fresh Gloo
+                          context at FIRST execution with a hard 30 s
+                          KeyValue deadline, and skewed per-rank compiles
+                          trip it (the flaky DEADLINE_EXCEEDED class).
+                          Use `parallel.barrier_synced_compile(...)` (or
+                          at least `coordination_barrier` between compile
+                          and first execution).
 * `unbounded-retry`     — a `while True` retry loop whose except handler
                           swallows the failure and loops again with no
                           attempt cap and no backoff: the r2 probe-kill
@@ -599,6 +611,55 @@ def rule_raw_metric_aggregation(tree, lines, relpath) -> List[Finding]:
     return out
 
 
+# multi-process rendezvous markers + the sanctioned barrier helpers
+_MULTIPROC_INIT = ("init_process_group", "init_distributed")
+_BARRIER_NAMES = {"barrier_synced_compile", "coordination_barrier",
+                  "wait_at_barrier"}
+
+
+def rule_unbarriered_collective_start(tree, lines, relpath) -> List[Finding]:
+    """Compile-without-barrier in a multi-process entry point (ISSUE 11
+    satellite): the CLAUDE.md Gloo pitfall as a mechanical check. Scope is
+    any production module that initializes a process group; the finding
+    lands on the first `.compile()` call when no barrier helper is
+    referenced anywhere in the module."""
+    init_line = 0
+    barriered = False
+    compile_line = 0
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            leaf = name.split(".")[-1]
+            if name.endswith("distributed.initialize") \
+                    or leaf in _MULTIPROC_INIT:
+                init_line = init_line or node.lineno
+            # the AOT idiom specifically — `<jitted>.lower(...).compile()`
+            # — so `re.compile(...)` and friends never match
+            if leaf == "compile" and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Call) \
+                    and _call_name(node.func.value).split(".")[-1] \
+                    == "lower":
+                compile_line = compile_line or node.lineno
+        if isinstance(node, ast.Name) and node.id in _BARRIER_NAMES:
+            barriered = True
+        if isinstance(node, ast.Attribute) and node.attr in _BARRIER_NAMES:
+            barriered = True
+    if not (init_line and compile_line) or barriered:
+        return []
+    if _suppressed("unbarriered-collective-start", lines, compile_line,
+                   compile_line):
+        return []
+    return [Finding(
+        rule="ast/unbarriered-collective-start", path=relpath,
+        line=compile_line, context="module",
+        message="multi-process entry point AOT-compiles without the "
+                "barrier law: the compiled program's fresh Gloo context "
+                "has a hard 30 s first-execution KeyValue deadline and "
+                "skewed per-rank compiles trip it — use "
+                "parallel.barrier_synced_compile (compile -> "
+                "coordination barrier -> execute)")]
+
+
 def _subtree_nodes(root) -> Iterable[ast.AST]:
     """Every node under `root` (inclusive), NOT descending into nested
     function/class defs — loop analysis must not be confused by a
@@ -667,7 +728,7 @@ RULES = (rule_per_call_timing, rule_queue_bypass, rule_env_platform_write,
          rule_raw_artifact_write, rule_device_get_in_loop,
          rule_missing_ref_citation, rule_raw_span_timing,
          rule_device_get_in_serving_loop, rule_unbounded_retry,
-         rule_raw_metric_aggregation)
+         rule_raw_metric_aggregation, rule_unbarriered_collective_start)
 
 
 # ---------------------------------------------------------------------------

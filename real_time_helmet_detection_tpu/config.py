@@ -61,6 +61,19 @@ class Config:
     data: Optional[str] = None
     batch_size: int = 16
     sub_divisions: int = 1        # gradient accumulation (ref train.py:124)
+    grad_accum: int = 1           # IN-STEP cross-replica gradient
+    # accumulation (ISSUE 11): the jitted step splits the global batch
+    # into this many equal micro-batches, scans them sequentially
+    # (accumulating gradients in fp32) and applies ONE optimizer update —
+    # effective batch = --batch-size at the HBM footprint of a
+    # batch/grad_accum step, and the cross-replica gradient all-reduce
+    # happens once per UPDATE instead of once per micro-batch (the
+    # FireCaffe communication/batch-size tradeoff, PAPERS.md). Differs
+    # from --sub-divisions (optax.MultiSteps across host steps: k host
+    # dispatches per update) — the two compose. BatchNorm statistics
+    # update sequentially per micro-batch, exactly as k consecutive
+    # steps would. Host path only (--device-augment keeps its fused
+    # per-batch step); requires batch-size % grad-accum == 0.
     start_epoch: int = 0
     end_epoch: int = 100
     num_workers: int = 8          # host-side data pipeline workers
@@ -256,6 +269,15 @@ class Config:
     prewarm: bool = False         # compile every multiscale bucket before
     # epoch 0 (device-augment paths): each bucket's first XLA compile
     # otherwise stalls a mid-epoch step 20-40s on a remote-TPU transport
+    async_eval: bool = False      # evaluate each saved checkpoint OFF the
+    # training devices (ISSUE 11): the chief spawns ONE background eval
+    # subprocess per checkpoint boundary, pinned to the CPU platform, on
+    # the checkpoint just written — training never stalls for eval (a
+    # busy evaluator skips a boundary rather than queueing). Results land
+    # in save-path/eval_async/e<N>/scores.json; train() reaps finished
+    # evals at each boundary and awaits the last one at exit. Single-host
+    # chief only. The reference has no in-training eval at all (its
+    # train/eval are separate invocations, ref main.py:9-17).
     auto_resume: int = 0          # elastic recovery: on a transient backend
     # failure, back off, probe the device, re-stage device-held state
     # (RNG key, HBM cache if lost), restore the newest checkpoint in
@@ -348,6 +370,20 @@ class Config:
                     "--sub-divisions > 1: optax.MultiSteps would "
                     "accumulate micro-gradients in bf16 — keep the fp32 "
                     "policy for accumulation runs")
+        if self.grad_accum < 1:
+            raise ValueError("--grad-accum must be >= 1, got %d"
+                             % self.grad_accum)
+        if self.grad_accum > 1:
+            if self.batch_size % self.grad_accum:
+                raise ValueError(
+                    "--grad-accum %d must divide --batch-size %d (equal "
+                    "fixed-shape micro-batches under jit)"
+                    % (self.grad_accum, self.batch_size))
+            if self.device_augment:
+                raise ValueError(
+                    "--grad-accum > 1 is host-input-path only: the fused "
+                    "--device-augment step augments per batch and has no "
+                    "micro-batch scan")
         if self.preset not in ("", "sweep-best"):
             raise ValueError("--preset must be '' or 'sweep-best', got %r"
                              % (self.preset,))

@@ -234,7 +234,19 @@ class ProcessBatchLoader(BatchLoader):
     """`BatchLoader` with a multi-process shared-memory producer.
 
     Same constructor, same sharding/shuffle/epoch semantics, bit-identical
-    batches (shared `epoch_indices` + per-batch augmentor reseed). The
+    batches (shared `epoch_indices` + per-batch augmentor reseed).
+
+    **Per-host sharding contract (ISSUE 11):** in a multi-process
+    data-parallel run each host constructs its loader with its own
+    `(rank, world_size)` (train() does) and this pool dispatches ONLY the
+    `indices[rank::world_size]` shard to its workers — no sample is
+    decoded twice across the fleet, and the union of all hosts' shards
+    covers the (seed, epoch)-keyed permutation exactly (wrap-padded so
+    every host issues the same number of collectives per epoch — the
+    DistributedSampler contract, ref train.py:54). The `quarantine`
+    poison-batch guard below applies per host to its own shard
+    (rank-disjointness + quarantine-under-sharding are pinned by
+    tests/test_shm_pool.py). The
     worker pool starts lazily at first iteration and persists across
     epochs; `close()` (or garbage collection) tears it down. Yielded
     batches hold READ-ONLY arrays backed by their own (already-unlinked)

@@ -32,22 +32,16 @@ DATA_AXIS = "data"
 SPATIAL_AXIS = "spatial"
 
 
-_DISTRIBUTED_INITIALIZED = False
-
-
 def init_distributed(cfg) -> None:
     """Multi-host rendezvous (≡ reference `dist.init_process_group`,
     /root/reference/train.py:42-45). No-op for single-host runs, and
     idempotent within a process (both train() and evaluate() call it at
-    their top, so a driver composing them must not double-rendezvous)."""
-    global _DISTRIBUTED_INITIALIZED
-    if getattr(cfg, "world_size", 1) > 1 and not _DISTRIBUTED_INITIALIZED:
-        # dist_url keeps the reference's tcp://host:port convention.
-        addr = cfg.dist_url.replace("tcp://", "")
-        jax.distributed.initialize(coordinator_address=addr,
-                                   num_processes=cfg.world_size,
-                                   process_id=cfg.rank)
-        _DISTRIBUTED_INITIALIZED = True
+    their top, so a driver composing them must not double-rendezvous).
+    The config-free core lives in distributed.init_process_group."""
+    from .distributed import init_process_group
+    # dist_url keeps the reference's tcp://host:port convention.
+    init_process_group(cfg.dist_url.replace("tcp://", ""),
+                       getattr(cfg, "world_size", 1), cfg.rank)
 
 
 def make_mesh(num_devices: int = 0, spatial: int = 1,

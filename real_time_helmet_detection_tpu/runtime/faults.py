@@ -74,7 +74,7 @@ FAULT_KINDS = ("device-loss", "hung-fetch", "slow-batch", "nan-batch",
 # the documented injection sites (callers may use others; these are the
 # instrumented ones and what seeded schedules draw from by default)
 SERVE_SITES = ("serve:dispatch", "serve:fetch")
-TRAIN_SITES = ("train:batch",)
+TRAIN_SITES = ("train:batch", "train:rank")
 LOADER_SITES = ("loader:batch", "loader:worker")
 ARTIFACT_SITES = ("artifact:write",)
 ALL_SITES = SERVE_SITES + TRAIN_SITES + LOADER_SITES + ARTIFACT_SITES
@@ -85,6 +85,10 @@ SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     "serve:dispatch": ("device-loss", "slow-batch"),
     "serve:fetch": ("device-loss", "hung-fetch", "slow-batch"),
     "train:batch": ("nan-batch", "slow-batch"),
+    # a data-parallel training RANK dies (ISSUE 11): the caller raises the
+    # UNAVAILABLE signature so the surviving processes' job classifies
+    # transient and requeues instead of hanging at the next collective
+    "train:rank": ("worker-death",),
     "loader:batch": ("nan-batch", "slow-batch"),
     "loader:worker": ("worker-death",),
     "artifact:write": ("torn-write",),

@@ -29,6 +29,8 @@ from __future__ import annotations
 import json
 import os
 import re
+import subprocess
+import sys
 import time
 from functools import partial
 from typing import Any, Dict, Optional
@@ -239,6 +241,89 @@ def _sentinel_update(cfg: Config, state: TrainState, tx, grads, batch_stats,
     return out_state, out_losses
 
 
+def _make_accum_step_body(model, tx, cfg: Config):
+    """`--grad-accum k` train-step body (ISSUE 11): the global batch is
+    split into `k` equal micro-batches scanned INSIDE the jitted step —
+    per-micro fwd+bwd with gradients accumulated in fp32 (a bf16
+    accumulator would lose k-1 rounding steps; this is why the policy
+    composes with `--param-policy bf16-compute`, whose grads are bf16),
+    then ONE optimizer update on the SUMMED micro-gradients — the
+    reference's accumulate-without-dividing convention (ref
+    train.py:128-136), deliberately identical to what `--sub-divisions`
+    feeds the optimizer (optax.MultiSteps' mean pre-scaled by k), so the
+    two accumulation paths and their composition share one effective-LR
+    convention (equivalence pinned by tests). Activation memory is that
+    of a batch/k step; the effective batch — and, under GSPMD data
+    parallelism, the cross-replica gradient all-reduce — is per UPDATE
+    (the FireCaffe communication/batch tradeoff, PAPERS.md). BatchNorm
+    statistics thread sequentially through the scan carry, exactly as k
+    consecutive steps would update them. The losses dict reports the
+    micro-batch MEAN, so one poisoned micro-batch makes the step's total
+    non-finite and the sentinel (`--sentinel`) skips the WHOLE
+    accumulated update — a partial window can never contaminate the
+    optimizer."""
+    k = int(cfg.grad_accum)
+
+    def accum(params, batch_stats, arrays, loss_scale=None):
+        def split(a):
+            return a.reshape((k, a.shape[0] // k) + tuple(a.shape[1:]))
+
+        micro = tuple(split(a) for a in arrays)
+        acc0 = jax.tree.map(lambda p: jnp.zeros(jnp.shape(p), jnp.float32),
+                            params)
+
+        def body(carry, xs):
+            bs, acc = carry
+            images, gt_heat, gt_off, gt_wh, mask = xs
+
+            def lf(p, b):
+                total, aux = loss_fn(p, b, model, images, gt_heat, gt_off,
+                                     gt_wh, mask, cfg)
+                if loss_scale is not None:
+                    total = total * loss_scale
+                return total, aux
+
+            (_, (bs, losses)), grads = jax.value_and_grad(
+                lf, has_aux=True)(params, bs)
+            acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), acc,
+                               grads)
+            return (bs, acc), losses
+
+        (batch_stats, acc), stacked = jax.lax.scan(
+            body, (batch_stats, acc0), micro)
+        # report the readable per-micro MEAN loss; feed the optimizer the
+        # SUM of micro-grads (unscaled — see the docstring's convention)
+        losses = jax.tree.map(lambda x: jnp.mean(x, axis=0), stacked)
+        if loss_scale is None:
+            grads = acc
+        else:
+            grads = jax.tree.map(lambda a: a / loss_scale, acc)
+        return grads, batch_stats, losses
+
+    if not getattr(cfg, "sentinel", False):
+        def step(state: TrainState, images, gt_heat, gt_off, gt_wh, mask):
+            grads, batch_stats, losses = accum(
+                state.params, state.batch_stats,
+                (images, gt_heat, gt_off, gt_wh, mask))
+            new_state = _optimizer_update(state, tx, cfg, grads, batch_stats)
+            return new_state, _maybe_telemetry(cfg, losses, grads,
+                                               state.params, new_state)
+
+        step.sentinel = False
+        return step
+
+    def step(state: TrainState, images, gt_heat, gt_off, gt_wh, mask,
+             loss_scale):
+        grads, batch_stats, losses = accum(
+            state.params, state.batch_stats,
+            (images, gt_heat, gt_off, gt_wh, mask), loss_scale=loss_scale)
+        return _sentinel_update(cfg, state, tx, grads, batch_stats, losses,
+                                loss_scale)
+
+    step.sentinel = True
+    return step
+
+
 def make_train_step_body(model, tx, cfg: Config):
     """The un-jitted train-step body: fwd + bwd + optimizer update.
 
@@ -247,6 +332,10 @@ def make_train_step_body(model, tx, cfg: Config):
     time steady-state compute without per-dispatch overhead) can reuse the
     exact production step.
 
+    `--grad-accum k` (ISSUE 11) routes to `_make_accum_step_body` (same
+    signature — an in-jit micro-batch scan with ONE optimizer update);
+    `--grad-accum 1` (the default) keeps the exact pre-PR body below.
+
     `--sentinel` (ISSUE 9) grows the signature by one trailing f32
     `loss_scale` argument (the host-side backoff lever; the loss is scaled
     before backward and the grads unscaled after, guarding the bf16
@@ -254,6 +343,8 @@ def make_train_step_body(model, tx, cfg: Config):
     `_sentinel_update`'s skip-step select. Sentinel OFF keeps the exact
     pre-PR body (bit-identity pinned by tests/test_sentinel.py); the
     built step carries `step.sentinel` so wrappers (scan, runners) adapt."""
+    if getattr(cfg, "grad_accum", 1) > 1:
+        return _make_accum_step_body(model, tx, cfg)
     if not getattr(cfg, "sentinel", False):
         def step(state: TrainState, images, gt_heat, gt_off, gt_wh, mask):
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -1131,6 +1222,158 @@ class SentinelMonitor:
         self._mg_scale.set(self.scale)
 
 
+# --async-eval worker (ISSUE 11): a fresh interpreter pinned to the CPU
+# platform BEFORE first backend use (the env var alone is unreliable — the
+# image's sitecustomize pins the platform at startup, CLAUDE.md), so the
+# evaluation never contends with the training devices (and never touches a
+# remote TPU claim — one process per chip). The spec file carries the full
+# eval Config; scores land next to it as scores.json (atomic write).
+_ASYNC_EVAL_SRC = (
+    "import json, os, sys\n"
+    "sys.path.insert(0, sys.argv[2])\n"
+    "import jax\n"
+    "jax.config.update('jax_platforms', 'cpu')\n"
+    "from real_time_helmet_detection_tpu.config import Config\n"
+    "from real_time_helmet_detection_tpu.evaluate import evaluate\n"
+    "from real_time_helmet_detection_tpu.utils import save_json\n"
+    "with open(sys.argv[1]) as f:\n"
+    "    spec = json.load(f)\n"
+    "cfg = Config(**spec['config'])\n"
+    "m = evaluate(cfg)\n"
+    "save_json(os.path.join(cfg.save_path, 'scores.json'),\n"
+    "          {'epoch': spec['epoch'], 'checkpoint': spec['checkpoint'],\n"
+    "           'map': float(m['map']),\n"
+    "           'ap': {k: float(v) for k, v in m.get('ap', {}).items()}})\n"
+)
+
+
+class AsyncEvaluator:
+    """Host side of `--async-eval` (ISSUE 11): per-checkpoint evaluation
+    OFF the training devices, without stalling the train loop.
+
+    At each checkpoint boundary the chief spawns ONE background subprocess
+    (CPU platform — see `_ASYNC_EVAL_SRC`) evaluating the checkpoint just
+    written; at most one eval is in flight, and a boundary arriving while
+    one still runs is SKIPPED (counted) rather than queued — eval is a
+    progress signal, not a training gate, and a queue would eventually
+    stall the loop it exists not to stall. Results:
+    `save_path/eval_async/e<N>/scores.json` (+ eval.log), reaped at the
+    next boundary and awaited (bounded) at the end of training. An eval
+    racing `--keep-ckpt` retention may lose its checkpoint mid-restore;
+    that surfaces as ok=False for that epoch, never as a training failure.
+    No reference analogue (train and eval are separate invocations there,
+    ref main.py:9-17)."""
+
+    FINALIZE_TIMEOUT_S = 900.0
+
+    def __init__(self, cfg: Config, tracer=None):
+        self.cfg = cfg
+        self._tracer = tracer
+        self._proc = None
+        self._current = None        # (epoch, outdir)
+        self._log_f = None
+        self.completed: list = []   # [{"epoch", "ok", "map"}]
+        self.skipped = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _eval_config(self, ckpt_path: str, outdir: str) -> dict:
+        import dataclasses
+        d = dataclasses.asdict(self.cfg)
+        d.update(train_flag=False, export_flag=False, model_load=ckpt_path,
+                 save_path=outdir, platform="cpu", world_size=1, rank=0,
+                 num_devices=0, device_prefetch=0, loader="thread",
+                 device_augment=False, cache_device=False, async_eval=False,
+                 async_ckpt=False, auto_resume=0, sentinel=False,
+                 grad_accum=1, profile=False, summary=False, span_log="",
+                 preset="", fault_inject="",
+                 imsize=self.cfg.imsize or self.cfg.multiscale[1],
+                 num_workers=min(2, max(1, self.cfg.num_workers)))
+        return d
+
+    def submit(self, epoch: int, ckpt_path: str) -> bool:
+        """Launch an eval of `ckpt_path`; False (and counted) when one is
+        already in flight. Never blocks on device or eval work."""
+        self.poll()
+        if self._proc is not None:
+            self.skipped += 1
+            print("%s: --async-eval: epoch %d eval still running; "
+                  "skipping the epoch %d boundary (%d skipped so far)"
+                  % (timestamp(), self._current[0], epoch, self.skipped),
+                  flush=True)
+            return False
+        outdir = os.path.join(self.cfg.save_path, "eval_async",
+                              "e%d" % epoch)
+        os.makedirs(outdir, exist_ok=True)
+        spec_path = os.path.join(outdir, "spec.json")
+        save_json(spec_path, {"epoch": epoch, "checkpoint": ckpt_path,
+                              "config": self._eval_config(ckpt_path,
+                                                          outdir)})
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {k: v for k, v in os.environ.items()
+               if k not in (HEARTBEAT_ENV, "TPU_QUEUE_STATUS")}
+        # the eval must never beat the TRAIN job's heartbeat (it would
+        # mask a hung trainer) nor write its status file
+        self._log_f = open(os.path.join(outdir, "eval.log"), "ab")
+        self._proc = subprocess.Popen(
+            [sys.executable, "-c", _ASYNC_EVAL_SRC, spec_path, repo],
+            stdout=self._log_f, stderr=subprocess.STDOUT, env=env)
+        self._current = (epoch, outdir)
+        if self._tracer is not None:
+            self._tracer.event("eval-async:submit", epoch=epoch,
+                               checkpoint=ckpt_path)
+        print("%s: --async-eval: epoch %d eval -> %s (pid %d)"
+              % (timestamp(), epoch, outdir, self._proc.pid), flush=True)
+        return True
+
+    def poll(self) -> None:
+        """Reap a finished eval (non-blocking); report its score."""
+        if self._proc is None or self._proc.poll() is None:
+            return
+        epoch, outdir = self._current
+        rc = self._proc.returncode
+        if self._log_f is not None:
+            self._log_f.close()
+            self._log_f = None
+        self._proc = None
+        self._current = None
+        scores_path = os.path.join(outdir, "scores.json")
+        rec = {"epoch": epoch, "ok": False, "map": None}
+        if rc == 0 and os.path.exists(scores_path):
+            try:
+                with open(scores_path) as f:
+                    rec.update(ok=True, map=json.load(f).get("map"))
+            except (OSError, json.JSONDecodeError):
+                pass
+        self.completed.append(rec)
+        if self._tracer is not None:
+            self._tracer.event("eval-async:done", epoch=epoch,
+                               ok=rec["ok"], map=rec["map"])
+        print("%s: --async-eval: epoch %d eval %s%s (see %s)"
+              % (timestamp(), epoch,
+                 "done, mAP %s" % rec["map"] if rec["ok"]
+                 else "FAILED (rc %s)" % rc,
+                 "" if rec["ok"] else " — training unaffected", outdir),
+              flush=True)
+
+    def finalize(self) -> None:
+        """Await the in-flight eval (bounded) at the end of training."""
+        if self._proc is not None:
+            try:
+                self._proc.wait(timeout=self.FINALIZE_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                print("%s: --async-eval: final eval still running after "
+                      "%.0fs; killing" % (timestamp(),
+                                          self.FINALIZE_TIMEOUT_S),
+                      flush=True)
+                self._proc.kill()
+                self._proc.wait()
+        self.poll()
+        if self._log_f is not None:
+            self._log_f.close()
+            self._log_f = None
+
+
 def _poison_batch(batch):
     """Apply a chaos `nan-batch` fault to a host batch (tests/chaos only;
     never on the production path). Poisons the first float field so the
@@ -1239,6 +1482,18 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
         if injector is not None:
             injector.maybe_fire(epoch, i)
         if chaos is not None:
+            rk = chaos.fire("train:rank", epoch=epoch, it=i)
+            if rk is not None and rk.kind == "worker-death":
+                # a training RANK died (ISSUE 11 chaos site): in a real
+                # multi-process run the survivors would hang at the next
+                # collective — surface the documented transient signature
+                # instead, so the shared classifier (runtime/errors.py)
+                # sends the job supervisor down its requeue path rather
+                # than a hung rendezvous eating the heartbeat deadline
+                raise InjectedBackendError(
+                    "UNAVAILABLE: injected worker death at epoch %d iter "
+                    "%d — a training rank is gone; restart/requeue the "
+                    "whole multi-process job" % (epoch, i))
             ev = chaos.fire("train:batch", epoch=epoch, it=i)
             if ev is not None and ev.kind == "nan-batch" \
                     and not isinstance(batch, StagedBatch):
@@ -1324,23 +1579,39 @@ def train(cfg: Config, chaos=None) -> TrainState:
     if ndev % cfg.spatial:
         raise ValueError("--spatial %d must divide the device count %d"
                          % (cfg.spatial, ndev))
-    # Only the data axis shards the batch; spatial shards H.
+    # Only the data axis shards the batch; spatial shards H. Under
+    # --grad-accum the sharded unit is the MICRO-batch (the in-jit scan
+    # reshapes (B, ...) -> (k, B/k, ...)), so divisibility is against B/k.
+    micro_batch = cfg.batch_size // max(1, cfg.grad_accum)
     data = ndev // cfg.spatial
     if jax.process_count() > 1:
         # Multi-host: shrinking the mesh would drop whole hosts' devices
         # while those processes still contribute local shards — fail loudly.
-        if cfg.batch_size % data:
+        if micro_batch % data:
             raise ValueError(
-                "multi-host run: --batch-size %d must be divisible by the "
-                "data mesh axis %d (devices %d / spatial %d)"
-                % (cfg.batch_size, data, ndev, cfg.spatial))
+                "multi-host run: the micro-batch %d (--batch-size %d / "
+                "--grad-accum %d) must be divisible by the data mesh axis "
+                "%d (devices %d / spatial %d)"
+                % (micro_batch, cfg.batch_size, cfg.grad_accum, data, ndev,
+                   cfg.spatial))
     else:
         # Single-host: clamp + largest batch-dividing data axis (shared
         # helper with the eval driver's mesh sizing)
         from .parallel import fit_data_mesh
-        ndev = fit_data_mesh(cfg.batch_size, cfg.num_devices, cfg.spatial)
+        ndev = fit_data_mesh(micro_batch, cfg.num_devices, cfg.spatial)
     mesh = make_mesh(ndev, spatial=cfg.spatial)
     is_chief = jax.process_index() == 0
+
+    if cfg.async_eval:
+        if cfg.async_ckpt:
+            # the eval subprocess restores the checkpoint the boundary
+            # just "wrote" — under async saves it may not be durable yet
+            raise ValueError("--async-eval requires synchronous "
+                             "checkpoints (drop --async-ckpt)")
+        if not cfg.data or not os.path.isdir(str(cfg.data)):
+            raise ValueError("--async-eval needs --data pointing at a "
+                             "dataset root (the eval subprocess scores "
+                             "the test split)")
 
     dataset, augmentor = load_dataset(cfg)
     if cfg.device_augment:
@@ -1487,6 +1758,11 @@ def train(cfg: Config, chaos=None) -> TrainState:
     if mwriter.enabled and is_chief:
         print("%s: metrics export -> %s" % (timestamp(), mwriter.path),
               flush=True)
+    # --async-eval (ISSUE 11): chief-only background eval of each saved
+    # checkpoint, off the training devices (CPU subprocess); the loop only
+    # ever submit()s and poll()s — it never waits on eval work.
+    evaluator = (AsyncEvaluator(cfg, tracer=tracer)
+                 if cfg.async_eval and is_chief else None)
     watchdog = HangWatchdog(cfg.hang_warn_seconds,
                             beat_file=os.environ.get(HEARTBEAT_ENV))
     if hasattr(loader, "worker_status"):
@@ -1544,6 +1820,10 @@ def train(cfg: Config, chaos=None) -> TrainState:
                         run_ckpts.append(path)
                         print("%s: epoch %d checkpoint -> %s"
                               % (timestamp(), epoch, path), flush=True)
+                        if evaluator is not None:
+                            # non-blocking: spawn (or skip, when one is
+                            # still in flight) and return immediately
+                            evaluator.submit(epoch, path)
                         # Retention applies to THIS run's checkpoints only.
                         # Async mode keeps one extra: the newest save may
                         # still be in flight (save() awaits only the
@@ -1687,6 +1967,8 @@ def train(cfg: Config, chaos=None) -> TrainState:
     finally:
         watchdog.pause("finalizing checkpoints")
         writer.finalize()
+        if evaluator is not None:
+            evaluator.finalize()  # bounded wait on the in-flight eval
         watchdog.stop()
         if hasattr(loader, "quarantined"):
             # the SHM loader's poison-batch quarantine count (ISSUE 9)

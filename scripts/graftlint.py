@@ -223,6 +223,27 @@ AST_FIXTURES = {
         "    rec = {'p50': h.quantile(0.5), 'p99': h.quantile(0.99)}\n"
         "run_as_job(main)\n",
     ),
+    "unbarriered-collective-start": (
+        # a multi-process entry point compiling + executing with no
+        # barrier: the first execution's fresh Gloo context (30 s hard
+        # KeyValue deadline) eats the per-rank compile skew
+        "import jax\n"
+        "from real_time_helmet_detection_tpu.parallel import "
+        "init_process_group\n"
+        "def main(rank, world, step, state, arrays):\n"
+        "    init_process_group('127.0.0.1:29500', world, rank)\n"
+        "    compiled = step.lower(state, *arrays).compile()\n"
+        "    return compiled(state, *arrays)\n",
+        # the barrier law: AOT-compile -> coordination barrier -> execute
+        "import jax\n"
+        "from real_time_helmet_detection_tpu.parallel import ("
+        "barrier_synced_compile, init_process_group)\n"
+        "def main(rank, world, step, state, arrays):\n"
+        "    init_process_group('127.0.0.1:29500', world, rank)\n"
+        "    compiled = barrier_synced_compile(step, (state, *arrays),\n"
+        "                                      name='train_step')\n"
+        "    return compiled(state, *arrays)\n",
+    ),
     "raw-span-timing": (
         # a chip-path script (acquires a backend) timing a span by hand
         "import time\n"

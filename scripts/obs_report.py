@@ -56,10 +56,12 @@ from real_time_helmet_detection_tpu.obs.spans import (  # noqa: E402
 from real_time_helmet_detection_tpu.utils import (  # noqa: E402
     atomic_write_bytes, save_json)
 
-SCHEMA = "obs-report-v2"
-READABLE_SCHEMAS = ("obs-report-v1", "obs-report-v2")
-# sections a v1 (pre-ISSUE-10) report lacks; read_report nulls them
+SCHEMA = "obs-report-v3"
+READABLE_SCHEMAS = ("obs-report-v1", "obs-report-v2", "obs-report-v3")
+# sections older schemas lack; read_report nulls them (v1 lacks both
+# groups, v2 lacks the v3 Scaling section)
 V2_SECTIONS = ("metrics", "slo")
+V3_SECTIONS = ("scaling",)
 
 
 def read_report(path: str) -> Optional[Dict]:
@@ -76,7 +78,7 @@ def read_report(path: str) -> Optional[Dict]:
     if rep.get("schema") not in READABLE_SCHEMAS:
         log("unreadable report schema %r in %s" % (rep.get("schema"), path))
         return None
-    for section in V2_SECTIONS:
+    for section in V2_SECTIONS + V3_SECTIONS:
         rep.setdefault(section, None)
     return rep
 
@@ -313,6 +315,51 @@ def summarize_slo(paths: List[str]) -> Optional[Dict]:
             "alert_total": len(alerts), "timeline": timeline}
 
 
+def summarize_scaling(paths: List[str],
+                      span_paths: List[str]) -> Optional[Dict]:
+    """The Scaling section (ISSUE 11): per-device-count efficiency tables
+    from the round's scaling-v2 artifact(s) joined with the harness's
+    `scale:compile`/`scale:barrier`/`scale:step` flight-recorder spans —
+    the artifact says WHAT scaled, the spans say where the wall time went
+    (per-rank compile skew included). Returns None when the round has no
+    scaling activity."""
+    files = []
+    for path in sorted(paths):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if d.get("schema") != "scaling-v2":
+            continue
+        files.append({"path": os.path.relpath(path, REPO)
+                      if path.startswith(REPO) else path,
+                      "config": d.get("config") or {},
+                      "curves": d.get("curves") or {},
+                      "rows_measured": sum(
+                          1 for r in d.get("results") or []
+                          if "img_per_sec" in r),
+                      "rows_error": sum(1 for r in d.get("results") or []
+                                        if "error" in r)})
+    spans: Dict[str, List[float]] = {}
+    for path in span_paths:
+        for rec in read_spans(path):
+            name = rec.get("name", "")
+            if name.startswith("scale:") \
+                    and isinstance(rec.get("dur_s"), (int, float)):
+                spans.setdefault(name[len("scale:"):], []).append(
+                    float(rec["dur_s"]))
+    span_digest = {}
+    for name, durs in sorted(spans.items()):
+        s = sorted(durs)
+        span_digest[name] = {"count": len(s),
+                             "total_s": round(sum(s), 3),
+                             "max_s": round(s[-1], 4)}
+    if not files and not span_digest:
+        return None
+    return {"files": files, "spans": span_digest}
+
+
 def summarize_queue(queue_dir: Optional[str]) -> Optional[Dict]:
     """Read-only tolerant replay of the job journal: per-job final state,
     attempts, salvage evidence, queued->terminal wall seconds."""
@@ -419,7 +466,8 @@ def summarize_loss_log(paths: List[str]) -> List[Dict]:
 def build_report(round_name: str, span_paths: List[str],
                  queue_dir: Optional[str], bench_paths: List[str],
                  loss_paths: List[str],
-                 metrics_paths: Optional[List[str]] = None) -> Dict:
+                 metrics_paths: Optional[List[str]] = None,
+                 scaling_paths: Optional[List[str]] = None) -> Dict:
     return {
         "schema": SCHEMA, "tool": "obs_report", "round": round_name,
         "spans": summarize_spans(span_paths),
@@ -427,6 +475,7 @@ def build_report(round_name: str, span_paths: List[str],
         "faults": summarize_faults(span_paths),
         "metrics": summarize_metrics(metrics_paths or []),
         "slo": summarize_slo(span_paths),
+        "scaling": summarize_scaling(scaling_paths or [], span_paths),
         "queue": summarize_queue(queue_dir),
         "bench": summarize_bench(bench_paths),
         "loss": summarize_loss_log(loss_paths),
@@ -558,6 +607,43 @@ def render_markdown(rep: Dict) -> str:
     else:
         lines.append("_no SLO alerts fired_")
     lines += [""]
+    scl = rep.get("scaling")
+    lines += ["## Scaling", ""]
+    if scl:
+        for row in scl["files"]:
+            cfg = row["config"]
+            lines += ["`%s` — pc=%s imsize=%s spatial=%s platform=%s "
+                      "(%d row(s) measured, %d error(s)):"
+                      % (row["path"], cfg.get("per_chip_batch", "?"),
+                         cfg.get("imsize", "?"), cfg.get("spatial", "?"),
+                         cfg.get("platform", "?"), row["rows_measured"],
+                         row["rows_error"]), ""]
+            for mode in ("weak", "strong", "multiproc"):
+                entries = row["curves"].get(mode) or []
+                if not entries:
+                    continue
+                lines += ["%s:" % mode, "",
+                          "| devices | procs | img/s | img/s/chip | "
+                          "eff | sharding eff | speedup |",
+                          "|---|---|---|---|---|---|---|"]
+                for e in entries:
+                    lines.append(
+                        "| %s | %s | %s | %s | %s | %s | %s |"
+                        % (e.get("devices", "?"), e.get("processes", 1),
+                           e.get("img_per_sec", "?"),
+                           e.get("img_per_sec_per_chip", "?"),
+                           e.get("weak_efficiency",
+                                 e.get("strong_efficiency", "")),
+                           e.get("sharding_efficiency", ""),
+                           e.get("speedup", "")))
+                lines += [""]
+        if scl["spans"]:
+            lines += ["Harness spans: " + ", ".join(
+                "%s ×%d (%.2fs total)" % (k, v["count"], v["total_s"])
+                for k, v in sorted(scl["spans"].items()))]
+    else:
+        lines.append("_no scaling activity recorded_")
+    lines += [""]
     q = rep["queue"]
     lines += ["## Queue", ""]
     if q:
@@ -616,9 +702,14 @@ def generate(args) -> Dict:
     if not metrics_paths:
         metrics_paths = sorted(glob.glob(os.path.join(round_dir, "obs",
                                                       "metrics*.jsonl")))
+    scaling_paths = list(getattr(args, "scaling", None) or [])
+    if not scaling_paths:
+        scaling_paths = sorted(glob.glob(os.path.join(round_dir,
+                                                      "scaling*.json")))
     rep = build_report(round_name, span_paths, queue_dir, bench_paths,
                        list(args.loss_log or []),
-                       metrics_paths=metrics_paths)
+                       metrics_paths=metrics_paths,
+                       scaling_paths=scaling_paths)
     out_dir = args.out or os.path.join(round_dir, "obs")
     os.makedirs(out_dir, exist_ok=True)
     save_json(os.path.join(out_dir, "report.json"), rep, indent=1,
@@ -689,6 +780,12 @@ def selfcheck() -> int:
         tracer.event("alert:serve-error-burn", frac=0.5, budget=0.1,
                      window=2)
         tracer.event("alert:train-step-drift", z=5.2, value=180.0)
+        # scaling harness taxonomy (ISSUE 11): compile/barrier/step spans
+        # — the Scaling section's span digest
+        tracer.record("scale:compile", 1.5, program="d8")
+        tracer.record("scale:compile", 2.5, program="d8")
+        tracer.record("scale:barrier", 0.2, program="d8")
+        tracer.record("scale:step", 0.4, devices=8, world=2)
         tracer.close()
         with open(span_path, "a") as f:  # graftlint: off=raw-artifact-write
             f.write('{"kind": "span", "torn')  # kill -9 mid-append twin
@@ -752,19 +849,40 @@ def selfcheck() -> int:
         with open(metrics_path, "a") as f:  # graftlint: off=raw-artifact-write
             f.write('{"schema": "obs-met')  # kill -9 mid-append twin
 
+        # scaling-v2 artifact (ISSUE 11): the Scaling section's table input
+        scaling_path = os.path.join(tmp, "scaling.json")
+        save_json(scaling_path, {
+            "schema": "scaling-v2",
+            "config": {"per_chip_batch": 2, "imsize": 64, "iters": 4,
+                       "spatial": 1, "max_devices": 8, "platform": "cpu"},
+            "results": [{"devices": 8, "processes": 2, "global_batch": 16,
+                         "img_per_sec": 300.0}],
+            "curves": {"weak": [{"devices": 8, "img_per_sec": 300.0,
+                                 "img_per_sec_per_chip": 37.5,
+                                 "step_ms": 426.0,
+                                 "weak_efficiency": 0.83,
+                                 "sharding_efficiency": 0.91}],
+                       "strong": [],
+                       "multiproc": [{"devices": 8, "processes": 2,
+                                      "img_per_sec": 290.0,
+                                      "img_per_sec_per_chip": 36.2,
+                                      "step_ms": 441.0,
+                                      "sharding_efficiency": 0.88}]}})
+
         ns = argparse.Namespace(round="rXX", span_log=[span_path],
                                 queue_dir=qdir, bench=[bench_path],
                                 loss_log=[loss_path],
                                 metrics=[metrics_path],
+                                scaling=[scaling_path],
                                 out=os.path.join(tmp, "out"))
         rep = generate(ns)
 
         check("schema tagged", rep["schema"] == SCHEMA)
         sp = rep["spans"]
         check("torn span tail dropped, all real records read",
-              sp["records"] == 35)  # meta + 4 steps + ckpt + hb + ctx
+              sp["records"] == 39)  # meta + 4 steps + ckpt + hb + ctx
         # + 16 serve spans + shed event + 7 fault/recover events +
-        # reload span + 2 alert events
+        # reload span + 2 alert events + 4 scale spans
         check("step span stats", sp["by_name"].get("step", {}).get(
             "count") == 4 and abs(sp["by_name"]["step"]["total_s"]
                                   - 0.1) < 1e-6)
@@ -821,6 +939,16 @@ def selfcheck() -> int:
               and "serve:state serving->degraded" in tl_names
               and tl_names.index("fault:device-loss")
               < tl_names.index("serve-error-burn"))
+        scl = rep["scaling"]
+        check("scaling section joined", scl is not None
+              and len(scl["files"]) == 1
+              and scl["files"][0]["rows_measured"] == 1
+              and scl["files"][0]["curves"]["weak"][0][
+                  "sharding_efficiency"] == 0.91)
+        check("scaling spans digested",
+              scl["spans"].get("compile", {}).get("count") == 2
+              and abs(scl["spans"]["compile"]["total_s"] - 4.0) < 1e-6
+              and scl["spans"].get("barrier", {}).get("count") == 1)
         q = rep["queue"]
         check("queue states joined", q is not None
               and q["jobs"]["bench"]["state"] == "done"
@@ -850,6 +978,9 @@ def selfcheck() -> int:
         check("markdown carries metrics + slo sections",
               "## Metrics" in md and "serve.completed=8" in md
               and "## SLO" in md and "serve-error-burn ×1" in md)
+        check("markdown carries scaling section",
+              "## Scaling" in md and "| 8 | 2 |" in md
+              and "0.91" in md and "Harness spans:" in md)
 
         # schema compat: the generated v2 report reads back through
         # read_report, and a committed v1 report (a pre-ISSUE-10 round)
@@ -866,7 +997,19 @@ def selfcheck() -> int:
         v1 = read_report(v1_path)
         check("v1 report readable with v2 sections nulled",
               v1 is not None and v1["metrics"] is None
-              and v1["slo"] is None and v1["spans"]["records"] == 3)
+              and v1["slo"] is None and v1["scaling"] is None
+              and v1["spans"]["records"] == 3)
+        # a committed v2 report (pre-ISSUE-11 round) nulls only Scaling
+        v2_path = os.path.join(tmp, "report_v2.json")
+        atomic_write_bytes(v2_path, json.dumps(
+            {"schema": "obs-report-v2", "round": "r12",
+             "metrics": {"files": []}, "slo": None,
+             "spans": {"records": 5}}).encode())
+        v2 = read_report(v2_path)
+        check("v2 report readable with scaling nulled",
+              v2 is not None and v2["scaling"] is None
+              and v2["metrics"] is not None
+              and v2["spans"]["records"] == 5)
         junk_path = os.path.join(tmp, "report_junk.json")
         atomic_write_bytes(junk_path, json.dumps(
             {"schema": "obs-report-v9"}).encode())
@@ -898,6 +1041,9 @@ def main(argv=None) -> int:
     p.add_argument("--metrics", action="append", default=[],
                    help="obs-metrics-v1 JSONL path; repeat (default "
                         "artifacts/<round>/obs/metrics*.jsonl)")
+    p.add_argument("--scaling", action="append", default=[],
+                   help="scaling-v2 artifact path; repeat (default "
+                        "artifacts/<round>/scaling*.json)")
     p.add_argument("--out", default=None,
                    help="output dir (default artifacts/<round>/obs)")
     p.add_argument("--selfcheck", action="store_true",

@@ -23,6 +23,12 @@ and CPU-only; pure file work, no backend):
   (fault-injected artifacts gate separately: `+faults` key suffix),
 * `artifacts/r*/roofline/*.json`            — roofline-v1 per-op-class
   HBM bytes (diff artifacts skipped),
+* `artifacts/r*/scaling*.json`              — scaling-v2 strong/weak
+  curves (ISSUE 11): per-device-count img/s/chip plus the efficiency
+  ratios, which get their own TIGHT tolerance class (`eff`, 15%
+  everywhere — an efficiency is a ratio of two runs on the same box at
+  the same time, so the ~2x box-speed noise mostly cancels; a -20%
+  sharding-efficiency regression must FAIL even on CPU),
 * `artifacts/r*/obs/metrics*.jsonl`         — live obs-metrics-v1
   snapshots (latency histogram p99s), schema obs-report-v2's Metrics
   source read the same way.
@@ -119,6 +125,12 @@ TOLERANCE = {
     "bytes": {"default": 0.02},
     "time": {"tpu": 0.10, "default": 0.50},
     "rate": {"tpu": 0.10, "default": 0.50},
+    # efficiency ratios (scaling-v2): numerator and denominator run on the
+    # same box back-to-back, so box-speed noise MOSTLY cancels — tight
+    # everywhere (15%: a -20% efficiency regression always fails, while
+    # residual cache/scheduling noise between the two runs of a ratio
+    # doesn't trip it)
+    "eff": {"default": 0.15},
 }
 
 
@@ -252,6 +264,47 @@ def obs_from_roofline(d: Dict, rnd: int, source: str) -> List[Obs]:
     return out
 
 
+def obs_from_scaling(d: Dict, rnd: int, source: str) -> List[Obs]:
+    """scaling-v2 curves (ISSUE 11): per-device-count throughput (rate
+    class — wide on CPU) and the efficiency/speedup ratios (the tight
+    `eff` class; see TOLERANCE). weak_efficiency only gates on real
+    hardware — on virtual CPU devices it reads host contention, which the
+    artifact's own note disclaims."""
+    if d.get("schema") != "scaling-v2":
+        return []
+    cfg = d.get("config") or {}
+    platform = cfg.get("platform") or "?"
+    sig = "%s,%s,pc%s,sp%s" % (platform, cfg.get("imsize", "?"),
+                               cfg.get("per_chip_batch", "?"),
+                               cfg.get("spatial", "?"))
+    curves = d.get("curves") or {}
+    out = []
+
+    def add(key, val, direction, klass):
+        if isinstance(val, (int, float)):
+            out.append(Obs("scaling[%s].%s" % (sig, key), val, direction,
+                           klass, platform, rnd, source))
+
+    for e in curves.get("weak") or []:
+        n = e.get("devices")
+        add("weak_img_per_chip@%s" % n, e.get("img_per_sec_per_chip"),
+            HIGHER, "rate")
+        add("sharding_eff@%s" % n, e.get("sharding_efficiency"),
+            HIGHER, "eff")
+        if platform == "tpu":
+            add("weak_eff@%s" % n, e.get("weak_efficiency"), HIGHER, "eff")
+    for e in curves.get("strong") or []:
+        add("strong_speedup@%s" % e.get("devices"), e.get("speedup"),
+            HIGHER, "eff")
+    for e in curves.get("multiproc") or []:
+        tag = "mp%s@%s" % (e.get("processes"), e.get("devices"))
+        add("%s_img_per_chip" % tag, e.get("img_per_sec_per_chip"),
+            HIGHER, "rate")
+        add("%s_sharding_eff" % tag, e.get("sharding_efficiency"),
+            HIGHER, "eff")
+    return out
+
+
 def obs_from_metrics_jsonl(path: str, rnd: int, source: str) -> List[Obs]:
     snaps = [s for s in read_metrics(path)
              if isinstance(s, dict) and s.get("schema") == "obs-metrics-v1"]
@@ -313,6 +366,14 @@ def scan_observations(root: str) -> List[Obs]:
         except (OSError, json.JSONDecodeError):
             continue
         out += obs_from_roofline(d, _round_of(path), rel(path))
+    for path in sorted(glob.glob(os.path.join(
+            root, "artifacts", "*", "scaling*.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        out += obs_from_scaling(d, _round_of(path), rel(path))
     for path in sorted(glob.glob(os.path.join(
             root, "artifacts", "*", "obs", "metrics*.jsonl"))):
         out += obs_from_metrics_jsonl(path, _round_of(path), rel(path))
@@ -424,14 +485,26 @@ def candidate_observations(path: str) -> List[Obs]:
         return obs_from_metrics_jsonl(path, rnd, path)
     try:
         with open(path) as f:
-            lines = [ln for ln in f.read().splitlines() if ln.strip()]
-        d = json.loads(lines[-1])
-    except (OSError, json.JSONDecodeError, IndexError):
+            text = f.read()
+    except OSError:
         raise SystemExit("--candidate: unreadable artifact %s" % path)
+    try:
+        # whole-file artifact (serve-bench / roofline / scaling — these
+        # may be indent-formatted, so the JSON spans many lines)
+        d = json.loads(text)
+    except json.JSONDecodeError:
+        # bench convention: a JSON-lines file, last line wins
+        try:
+            lines = [ln for ln in text.splitlines() if ln.strip()]
+            d = json.loads(lines[-1])
+        except (json.JSONDecodeError, IndexError):
+            raise SystemExit("--candidate: unreadable artifact %s" % path)
     if d.get("schema") == "serve-bench-v1":
         return obs_from_serve_artifact(d, rnd, path)
     if d.get("schema") == "roofline-v1":
         return obs_from_roofline(d, rnd, path)
+    if d.get("schema") == "scaling-v2":
+        return obs_from_scaling(d, rnd, path)
     if isinstance(d.get("parsed"), dict):
         d = d["parsed"]
     return obs_from_bench_line(d, rnd, path)
@@ -565,6 +638,38 @@ def _fixture_tree(tmp: str) -> None:
     os.makedirs(os.path.dirname(mpath), exist_ok=True)
     mw = MetricsWriter(mreg, mpath, period_s=0.0)
     mw.close()
+    # scaling-v2 curves (ISSUE 11): an 8-device weak row at 90%
+    # sharding efficiency — the acceptance fixture a -20% candidate
+    # regression must FAIL against
+    jline(os.path.join(tmp, "artifacts", "r02", "scaling.json"),
+          _scaling_fixture(0.90, 41.0))
+
+
+def _scaling_fixture(eff8: float, img_chip8: float) -> Dict:
+    return {"schema": "scaling-v2",
+            "config": {"per_chip_batch": 2, "imsize": 64, "iters": 4,
+                       "spatial": 1, "max_devices": 8, "platform": "cpu"},
+            "results": [],
+            "curves": {
+                "weak": [
+                    {"devices": 1, "img_per_sec": 45.0,
+                     "img_per_sec_per_chip": 45.0, "step_ms": 44.0,
+                     "weak_efficiency": 1.0, "sharding_efficiency": 1.0},
+                    {"devices": 8, "img_per_sec": 8 * img_chip8,
+                     "img_per_sec_per_chip": img_chip8, "step_ms": 390.0,
+                     "weak_efficiency": round(img_chip8 / 45.0, 4),
+                     "sharding_efficiency": eff8}],
+                "strong": [
+                    {"devices": 1, "img_per_sec": 40.0,
+                     "img_per_sec_per_chip": 40.0, "step_ms": 400.0,
+                     "speedup": 1.0, "strong_efficiency": 1.0},
+                    {"devices": 8, "img_per_sec": 38.0,
+                     "img_per_sec_per_chip": 4.75, "step_ms": 420.0,
+                     "speedup": 0.95, "strong_efficiency": 0.1188}],
+                "multiproc": [
+                    {"devices": 8, "processes": 2, "img_per_sec": 300.0,
+                     "img_per_sec_per_chip": 37.5, "step_ms": 426.0,
+                     "sharding_efficiency": 0.85}]}}
 
 
 def selfcheck() -> int:
@@ -645,6 +750,25 @@ def selfcheck() -> int:
         check("2x serve p99 FAILS the gate",
               run(["--root", tmp, "--ledger", ledger,
                    "--candidate", bads]) == 1)
+        # the ISSUE 11 acceptance fixture: a -20% sharding-efficiency
+        # regression must FAIL even on CPU — efficiency is a same-box
+        # ratio, so it gates in the tight `eff` class (10%), not the
+        # box-noise rate class
+        check("scaling efficiency tracked in the ledger",
+              "scaling[cpu,64,pc2,sp1].sharding_eff@8"
+              in load_ledger(ledger)["entries"])
+        bad_eff = os.path.join(tmp, "cand_scaling.json")
+        save_json(bad_eff, _scaling_fixture(round(0.90 * 0.8, 4), 41.0))
+        check("-20% sharding efficiency FAILS the gate",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", bad_eff]) == 1)
+        # a small efficiency wiggle + a 27%-slower CPU throughput pass
+        # (eff within 10%; rate under the CPU box-noise tolerance)
+        ok_eff = os.path.join(tmp, "cand_scaling_ok.json")
+        save_json(ok_eff, _scaling_fixture(0.88, 30.0))
+        check("efficiency wiggle + cpu throughput dip pass",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", ok_eff]) == 0)
         # within-tolerance chip wiggle and a 30%-slow CPU line both pass
         okc = os.path.join(tmp, "cand_ok.json")
         save_json(okc, {"platform": "tpu", "imsize": 512, "batch": 16,

@@ -33,18 +33,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-# jax 0.4.37 creates the CPU client with NO cross-process collectives unless
-# the implementation is named explicitly — without this every multi-process
-# compile dies with "Multiprocess computations aren't implemented on the CPU
-# backend" (the r5-era image defaulted to gloo; this one does not). Guarded:
-# the option name is version-fragile, and a missing flag should surface as
-# this warning next to the eventual compile error, not an opaque crash here.
-try:
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-except (AttributeError, ValueError) as _e:
-    print("warning: could not select gloo CPU collectives under jax %s "
-          "(%s); multi-process CPU compiles will likely fail"
-          % (jax.__version__, _e), flush=True)
+# Gloo CPU cross-process collectives (guarded helper, parallel/distributed.py
+# — without it every multi-process compile dies with "Multiprocess
+# computations aren't implemented on the CPU backend").
+from real_time_helmet_detection_tpu.parallel import (  # noqa: E402
+    use_gloo_cpu_collectives)
+
+use_gloo_cpu_collectives()
 # The persistent compile cache arrives via JAX_COMPILATION_CACHE_DIR,
 # inherited from conftest.py's environment — each worker is a fresh
 # process, and without it every multi-process test recompiles the
@@ -89,40 +84,18 @@ def main() -> None:
     local = tuple(a[rank * per:(rank + 1) * per] for a in g)
     arrays = shard_batch(mesh, local, spatial_dims=[1] * 5)
 
-    # AOT-compile, BARRIER, then execute. Every compiled program creates
-    # its own fresh Gloo context at first execution (observed keys
-    # cpu:gloo/<devices>/1, /2, ...), and that context's KeyValue
-    # exchange carries a hard 30 s deadline — but per-rank compile times
-    # on a loaded 1-core box skew by minutes, so executing straight out
-    # of jit tripped the deadline (flaky DEADLINE_EXCEEDED, 2 of 4 full
-    # suite runs). The coordination-service barrier (gRPC — no Gloo, so
-    # no 30 s context deadline of its own) realigns the ranks after the
-    # skewed compiles; the first execution then starts within
-    # milliseconds on every rank.
-    compiled = step.lower(state, *arrays).compile()
-    if world > 1:  # single-rank smoke runs have no coordination client
-        # PRIVATE jax API (the public sync_global_devices would create a
-        # fresh Gloo context with its own 30 s KeyValue deadline — exactly
-        # the failure this barrier works around). Guarded so a jax upgrade
-        # that moves/renames it fails with an actionable message instead
-        # of an opaque AttributeError mid-rendezvous (ADVICE r5 #4).
-        try:
-            from jax._src import distributed
-            client = distributed.global_state.client
-            if client is None:
-                raise AttributeError("global_state.client is None")
-        except (ImportError, AttributeError) as e:
-            raise RuntimeError(
-                "jax._src.distributed.global_state.client is unavailable "
-                "under jax %s (%s): this private API backs the "
-                "compile/execute barrier that keeps skewed per-rank "
-                "compiles from tripping Gloo's 30s first-execution "
-                "deadline; find its new home in this jax version (a "
-                "public sync_global_devices is NOT a substitute — it "
-                "would recreate the Gloo deadline)" % (jax.__version__, e)
-            ) from e
-        client.wait_at_barrier(
-            "train_step_compiled", timeout_in_ms=15 * 60 * 1000)
+    # AOT-compile, BARRIER, then execute: the barrier law (ISSUE 11 —
+    # formerly inlined here, now the public parallel.barrier_synced_compile
+    # helper). Every compiled program creates its own fresh Gloo context at
+    # first execution with a hard 30 s KeyValue deadline, but per-rank
+    # compile times on a loaded 1-core box skew by minutes — executing
+    # straight out of jit tripped the deadline (flaky DEADLINE_EXCEEDED, 2
+    # of 4 full suite runs). The coordination-service barrier (gRPC — no
+    # Gloo deadline of its own) realigns the ranks after the skewed
+    # compiles. process_count()==1 smoke runs skip the barrier inside.
+    from real_time_helmet_detection_tpu.parallel import barrier_synced_compile
+    compiled = barrier_synced_compile(step, (state, *arrays),
+                                      name="train_step")
     state, losses = compiled(state, *arrays)
     jax.block_until_ready(losses["total"])
     result = {k: float(v) for k, v in losses.items()}

@@ -215,6 +215,27 @@ def test_find_last_tpu_result_carries_int8_fields(tmp_path):
     assert got["latency_ms_b1"] == 1.4
 
 
+def test_find_last_tpu_result_carries_topology_fields(tmp_path):
+    """ISSUE 11 satellite: the JSON line's device_count/mesh_shape keys
+    survive find_last_tpu_result (a chip line from a pod slice must say
+    what the timed programs actually spanned), and the pre-existing
+    consumer contract is unchanged."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r13", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1250.0,
+        "mfu_train": 0.53, "device_count": 4,
+        "mesh_shape": {"data": 1, "spatial": 1}})
+    got = bench.find_last_tpu_result(root)
+    assert got["device_count"] == 4
+    assert got["mesh_shape"] == {"data": 1, "spatial": 1}
+    assert got["value"] == 1250.0 and got["mfu_train"] == 0.53
+    # pre-ISSUE-11 lines (no topology fields) still read fine
+    _write_bench_artifact(root, "r14", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1260.0})
+    got = bench.find_last_tpu_result(root)
+    assert got["value"] == 1260.0 and "device_count" not in got
+
+
 def test_find_last_tpu_result_carries_obs_fields(tmp_path):
     """ISSUE 6 satellite: the JSON line's flight-recorder keys
     (recompile_count, loadavg) survive find_last_tpu_result; span_log is a

@@ -270,6 +270,122 @@ def test_train_nan_rollback_matches_clean_resume(voc_root, tmp_path):
     assert all(np.isfinite(v) for v in log_a["total"])
 
 
+_RANK_JOB = (
+    "import os, sys\n"
+    "sys.path.insert(0, os.environ['REPO'])\n"
+    "import jax\n"
+    "jax.config.update('jax_platforms', 'cpu')\n"
+    "from real_time_helmet_detection_tpu.config import Config\n"
+    "from real_time_helmet_detection_tpu.runtime import (ChaosInjector,"
+    " FaultSchedule, run_as_job)\n"
+    "from real_time_helmet_detection_tpu.train import ("
+    "find_latest_checkpoint, train)\n"
+    "def main():\n"
+    "    save = os.environ['SAVE']\n"
+    "    marker = os.environ['MARKER']\n"
+    "    kw = dict(train_flag=True, num_stack=1, hourglass_inch=8,\n"
+    "              num_cls=2, imsize=64, batch_size=2, end_epoch=2,\n"
+    "              ckpt_interval=1, print_interval=1, num_workers=0,\n"
+    "              data=os.environ['VOC'], save_path=save,\n"
+    "              hang_warn_seconds=0, summary=False)\n"
+    "    chaos = None\n"
+    "    if not os.path.exists(marker):\n"
+    "        open(marker, 'w').write('1')\n"
+    "        # seeded worker-death drawn from the train:rank site; max_at=4\n"
+    "        # keeps the trigger inside this run's 4 iterations\n"
+    "        chaos = ChaosInjector(FaultSchedule.seeded(\n"
+    "            int(os.environ['SEED']), n=1, sites=('train:rank',),\n"
+    "            max_at=4))\n"
+    "    else:\n"
+    "        latest = find_latest_checkpoint(save)\n"
+    "        if latest:\n"
+    "            kw['model_load'] = latest\n"
+    "    train(Config(**kw), chaos=chaos)\n"
+    "run_as_job(main)\n"
+)
+
+
+def test_worker_death_classified_transient_supervisor_requeues(
+        voc_root, tmp_path):
+    """ISSUE 11 satellite: a SEEDED worker-death schedule kills a training
+    rank mid-run. The acceptance chain: the raised error carries the
+    UNAVAILABLE signature (runtime/errors.py classifies it TRANSIENT —
+    never a hung rendezvous), the job supervisor salvages + requeues with
+    backoff, attempt 2 resumes from the newest complete checkpoint, and
+    the healed run's loss history + final weights are BIT-identical to an
+    uninterrupted run of the same config."""
+    import json
+    import sys
+
+    from real_time_helmet_detection_tpu.runtime import (
+        InjectedBackendError, JobSpec, Spool, Supervisor,
+        is_transient_backend_error)
+    from real_time_helmet_detection_tpu.runtime.faults import SITE_KINDS
+    from real_time_helmet_detection_tpu.train import train
+
+    # the classification link, pinned directly: the train:rank site only
+    # draws worker-death, and the error train_epoch raises for it is
+    # transient for the shared classifier
+    assert SITE_KINDS["train:rank"] == ("worker-death",)
+    assert is_transient_backend_error(InjectedBackendError(
+        "UNAVAILABLE: injected worker death at epoch 0 iter 1"))
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    save = str(tmp_path / "killed")
+    spool = Spool(str(tmp_path / "queue"))
+    env = {"REPO": repo, "SAVE": save, "VOC": voc_root, "SEED": "11",
+           "MARKER": str(tmp_path / "attempt_marker"),
+           "PYTHONPATH": os.pathsep.join(
+               [repo] + [p for p in os.environ.get(
+                   "PYTHONPATH", "").split(os.pathsep) if p])}
+    spool.enqueue(JobSpec(
+        job="train-dp", argv=[sys.executable, "-c", _RANK_JOB], cwd=repo,
+        heartbeat_timeout_s=500.0, max_attempts=3,
+        backoff_base_s=0.1, backoff_cap_s=0.2, env=env))
+
+    class _InstantWaiter:
+        pid = 0
+
+        def poll(self):
+            return 0
+
+    sup = Supervisor(spool, relay_probe=lambda: True,
+                     waiter_factory=_InstantWaiter, poll_s=0.1,
+                     kill_grace_s=2.0)
+    summary = sup.run()
+    assert summary["jobs"]["train-dp"]["state"] == "done"
+    assert summary["jobs"]["train-dp"]["attempt"] == 2, \
+        "the killed rank never triggered a requeue"
+
+    # journal truth: the first attempt died TRANSIENT (the UNAVAILABLE
+    # signature), was salvaged and requeued behind a backoff gate
+    with open(spool.path) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    spool.close()
+    salv = [r for r in recs if r.get("kind") == "state"
+            and r.get("state") == "salvaged"]
+    assert salv and "UNAVAILABLE" in str(salv[0].get("reason"))
+    requeues = [r for r in recs if r.get("kind") == "state"
+                and r.get("state") == "queued"
+                and r.get("attempt", 1) == 2]
+    assert requeues and requeues[0].get("not_before", 0) > 0
+
+    # the healed run vs an uninterrupted twin: bit-identical history +
+    # weights (batch content is a pure function of (seed, epoch, idx))
+    save_b = str(tmp_path / "clean")
+    train(_train_cfg(voc_root, save_b, sentinel=False,
+                     sentinel_backoff=0.5))
+    for x, y in zip(_params_of(os.path.join(save, "check_point_2")),
+                    _params_of(os.path.join(save_b, "check_point_2"))):
+        assert x.tobytes() == y.tobytes(), \
+            "resumed run diverged from the uninterrupted twin"
+    with open(os.path.join(save, "check_point_2", "loss_log.json")) as f:
+        log_a = json.load(f)
+    with open(os.path.join(save_b, "check_point_2", "loss_log.json")) as f:
+        log_b = json.load(f)
+    assert log_a["total"] == log_b["total"]
+
+
 def test_train_skip_only_when_divergence_not_sustained(voc_root, tmp_path):
     """A SINGLE poison batch is absorbed by the in-jit skip (no rollback,
     no crash): the run completes with exactly one skipped step counted by

@@ -101,6 +101,25 @@ AST_CASES = [
      "    for v in lats:\n"
      "        h.observe(v)\n"
      "    return {'p50': h.quantile(0.5), 'p99': h.quantile(0.99)}\n"),
+    ("ast/unbarriered-collective-start", "scripts/x.py",
+     # a multi-process entry point AOT-compiling + executing with no
+     # barrier between compile and the Gloo-context-creating first run
+     "import jax\n"
+     "from real_time_helmet_detection_tpu.parallel import "
+     "init_process_group\n"
+     "def main(rank, world, step, state, arrays):\n"
+     "    init_process_group('127.0.0.1:29500', world, rank)\n"
+     "    compiled = step.lower(state, *arrays).compile()\n"
+     "    return compiled(state, *arrays)\n",
+     # the barrier law via the public helper
+     "import jax\n"
+     "from real_time_helmet_detection_tpu.parallel import ("
+     "barrier_synced_compile, init_process_group)\n"
+     "def main(rank, world, step, state, arrays):\n"
+     "    init_process_group('127.0.0.1:29500', world, rank)\n"
+     "    compiled = barrier_synced_compile(step, (state, *arrays),\n"
+     "                                      name='train_step')\n"
+     "    return compiled(state, *arrays)\n"),
     ("ast/unbounded-retry", "scripts/x.py",
      # the r2 probe-kill class: swallow + loop forever, no cap, no pause
      "import jax\n"
@@ -137,6 +156,35 @@ def test_queue_bypass_scoped_to_chip_scripts():
         ast_rules.lint_source(src, "scripts/x.py"))
     assert "ast/queue-bypass" not in rules_of(
         ast_rules.lint_source(src, "real_time_helmet_detection_tpu/x.py"))
+
+
+def test_unbarriered_collective_start_scope():
+    """The rule needs BOTH markers: a single-process AOT compile (bench's
+    whole idiom) never fires, a multi-process module that merely calls
+    re.compile never fires, and `coordination_barrier` (the manual form
+    of the law) also satisfies it."""
+    single = ("import jax\n"
+              "def f(step, x):\n"
+              "    return step.lower(x).compile()\n")
+    assert "ast/unbarriered-collective-start" not in rules_of(
+        ast_rules.lint_source(single, "scripts/x.py"))
+    re_only = ("import re\n"
+               "from real_time_helmet_detection_tpu.parallel import "
+               "init_process_group\n"
+               "def f(world, rank):\n"
+               "    init_process_group('h:1', world, rank)\n"
+               "    return re.compile('x')\n")
+    assert "ast/unbarriered-collective-start" not in rules_of(
+        ast_rules.lint_source(re_only, "scripts/x.py"))
+    manual = ("from real_time_helmet_detection_tpu.parallel import ("
+              "coordination_barrier, init_process_group)\n"
+              "def f(step, x, world, rank):\n"
+              "    init_process_group('h:1', world, rank)\n"
+              "    compiled = step.lower(x).compile()\n"
+              "    coordination_barrier('compiled:f')\n"
+              "    return compiled(x)\n")
+    assert "ast/unbarriered-collective-start" not in rules_of(
+        ast_rules.lint_source(manual, "scripts/x.py"))
 
 
 def test_unbounded_retry_exemptions():

@@ -73,6 +73,38 @@ def test_process_loader_bit_identical_to_thread(voc_root, raw):
         p.close()
 
 
+def test_process_loader_per_host_shard_disjoint_and_quarantined(voc_root):
+    """ISSUE 11: the SHM pool is per-host sharded — two rank loaders of a
+    world-2 run decode DISJOINT sample shards whose union covers the
+    (seed, epoch) permutation exactly (wrap-padded; no duplicated decode
+    work across the fleet), and the PR 9 poison-batch quarantine stays
+    armed per host on its own shard."""
+    ds = VOCDataset(voc_root, "trainval")
+    aug = TrainAugmentor(multiscale_flag=False, multiscale=[32, 64, 16],
+                         rng=np.random.default_rng(9))
+    loaders = [ProcessBatchLoader(
+        ds, aug, batch_size=2, num_workers=1, prefetch=1, seed=5,
+        shuffle=True, drop_last=True, max_boxes=8, rank=r, world_size=2,
+        quarantine=True) for r in (0, 1)]
+    try:
+        names = []
+        for ld in loaders:
+            ld.set_epoch(1)
+            shard = [i["annotation"]["filename"] for b in ld
+                     for i in b.infos]
+            assert len(shard) == 4  # 10 imgs -> 5/host, b2 drop_last
+            names.append(shard)
+            assert ld.quarantined == 0  # clean data: nothing quarantined
+        assert not set(names[0]) & set(names[1]), \
+            "rank shards overlap: duplicated decode work"
+        # union covers 8 distinct files of the permutation's first 8
+        assert len(set(names[0]) | set(names[1])) == 8
+        assert not any(ld._fell_back for ld in loaders)
+    finally:
+        for ld in loaders:
+            ld.close()
+
+
 def test_process_loader_epochs_differ(voc_root):
     """(seed, epoch) keying: different epochs yield different augmentation
     streams (same canvas grid could coincide; pixel content must not)."""
