@@ -79,11 +79,11 @@ DEFAULT_HBM = 819e9  # v5e
 # and runner_drive.py (they diverged in r5: mfu_breakdown defaulted to r05
 # while the rest stayed at r04, scattering same-round artifacts — ADVICE
 # r5 #3); bump it here when a new round starts, or override per-run with
-# $GRAFT_ROUND. r13 = the data-parallel scale-out round (ISSUE 11:
-# multi-process pjit training, barrier law, the rebuilt scaling.py
-# curves); earlier rounds' artifact dirs are committed history and must
-# not be overwritten.
-GRAFT_ROUND_DEFAULT = "r13"
+# $GRAFT_ROUND. r14 = the serving-fleet round (ISSUE 12: FleetRouter over
+# N ServingEngine replicas — per-tenant SLOs, canary rollout, replica
+# self-healing, the serve_bench --replicas fleet curves); earlier rounds'
+# artifact dirs are committed history and must not be overwritten.
+GRAFT_ROUND_DEFAULT = "r14"
 
 # v5e int8 MXU peak (2x the bf16 peak — jax-ml scaling-book): the
 # denominator for int8-path MFU and the hardware case for --infer-dtype

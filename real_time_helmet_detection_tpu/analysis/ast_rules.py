@@ -76,6 +76,17 @@ analogue is manual code review, ref /root/reference/README.md:1):
                           Use `parallel.barrier_synced_compile(...)` (or
                           at least `coordination_barrier` between compile
                           and first execution).
+* `engine-bypass-in-fleet` — raw ServingEngine construction or a direct
+                          `<x>.engine.submit(...)` inside fleet/router
+                          code paths (serving/ fleet modules + anything
+                          referencing FleetRouter): traffic that skips
+                          FleetRouter dispatch silently escapes tenant
+                          budgets, SLO penalty boxes, the canary split
+                          and the re-dispatch ack guarantee. The
+                          sanctioned factory/dispatch scopes and the
+                          single-engine surfaces (evaluate/demo/export-
+                          style uses in modules that also drive the
+                          fleet) are allowlisted.
 * `unbounded-retry`     — a `while True` retry loop whose except handler
                           swallows the failure and loops again with no
                           attempt cap and no backoff: the r2 probe-kill
@@ -141,6 +152,25 @@ SERVING_PREFIX = "real_time_helmet_detection_tpu/serving/"
 SERVING_FETCH_ALLOW = {
     "real_time_helmet_detection_tpu/serving/engine.py::"
     "ServingEngine._fetch_loop",
+}
+# fleet/router code paths (ISSUE 12): modules under serving/ whose name
+# marks them as fleet code, plus ANY module that references FleetRouter —
+# in those, raw ServingEngine construction or direct replica-engine
+# submits bypass the router's tenant/SLO/canary accounting. The
+# sanctioned points (and the single-engine surfaces of modules that also
+# drive the fleet — evaluate/demo/export-style uses) are allowlisted.
+FLEET_FILE_MARKERS = ("fleet", "router")
+FLEET_ENGINE_ALLOW = {
+    # THE sanctioned replica construction + dispatch scopes
+    "real_time_helmet_detection_tpu/serving/fleet.py::"
+    "FleetRouter._spawn",
+    "real_time_helmet_detection_tpu/serving/fleet.py::"
+    "FleetRouter._dispatch",
+    # serve_bench: the replica factory + the single-engine bench paths
+    "scripts/serve_bench.py::make_replica_factory",
+    "scripts/serve_bench.py::make_replica_factory.factory",
+    "scripts/serve_bench.py::run_bench",
+    "scripts/serve_bench.py::selfcheck",
 }
 RAW_WRITE_ALLOW = {
     # the atomic-write implementation itself
@@ -522,6 +552,63 @@ def rule_device_get_in_serving_loop(tree, lines, relpath) -> List[Finding]:
     return out
 
 
+def _references_fleet_router(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id == "FleetRouter":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "FleetRouter":
+            return True
+        if isinstance(node, (ast.Import, ast.ImportFrom)) \
+                and any(a.name == "FleetRouter" for a in node.names):
+            return True
+    return False
+
+
+def rule_engine_bypass_in_fleet(tree, lines, relpath) -> List[Finding]:
+    """Raw ServingEngine use inside fleet/router code paths (ISSUE 12
+    satellite): constructing an engine directly, or submitting to a
+    replica's engine (`<x>.engine.submit/predict_many`), skips
+    FleetRouter dispatch — per-tenant budgets, SLO penalty boxes, canary
+    traffic splits and the re-dispatch ack guarantee all silently stop
+    applying to that traffic. Scope: serving/ modules named like fleet
+    code, plus any module referencing FleetRouter; the sanctioned
+    construction/dispatch scopes and single-engine surfaces are
+    allowlisted (FLEET_ENGINE_ALLOW)."""
+    base = os.path.basename(relpath)
+    fleet_file = relpath.startswith(SERVING_PREFIX) \
+        and any(m in base for m in FLEET_FILE_MARKERS)
+    if not fleet_file and not _references_fleet_router(tree):
+        return []
+    out = []
+    for qual, node, body in _iter_scopes(tree):
+        if "%s::%s" % (relpath, qual) in FLEET_ENGINE_ALLOW:
+            continue
+        for call in _scope_calls(body):
+            name = _call_name(call)
+            parts = name.split(".")
+            hit = None
+            if parts[-1] == "ServingEngine":
+                hit = "raw ServingEngine construction"
+            elif len(parts) >= 2 and parts[-2] == "engine" \
+                    and parts[-1] in ("submit", "predict_many"):
+                hit = "direct replica-engine %s()" % parts[-1]
+            if hit is None:
+                continue
+            if _suppressed("engine-bypass-in-fleet", lines, call.lineno,
+                           getattr(call, "end_lineno", call.lineno)):
+                continue
+            out.append(Finding(
+                rule="ast/engine-bypass-in-fleet", path=relpath,
+                line=call.lineno, context=qual,
+                message="%s in a fleet/router code path bypasses "
+                        "FleetRouter dispatch — tenant budgets, SLO "
+                        "penalty boxes, the canary split and the "
+                        "re-dispatch ack guarantee stop applying; go "
+                        "through router.submit (or the allowlisted "
+                        "factory/dispatch scopes)" % hit))
+    return out
+
+
 _STAT_FNS = {"percentile", "quantile", "quantiles", "median"}
 
 
@@ -728,7 +815,8 @@ RULES = (rule_per_call_timing, rule_queue_bypass, rule_env_platform_write,
          rule_raw_artifact_write, rule_device_get_in_loop,
          rule_missing_ref_citation, rule_raw_span_timing,
          rule_device_get_in_serving_loop, rule_unbounded_retry,
-         rule_raw_metric_aggregation, rule_unbarriered_collective_start)
+         rule_raw_metric_aggregation, rule_unbarriered_collective_start,
+         rule_engine_bypass_in_fleet)
 
 
 # ---------------------------------------------------------------------------

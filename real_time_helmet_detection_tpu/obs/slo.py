@@ -148,6 +148,15 @@ class ErrorBurnRule(Rule):
         self._err0 = 0
         self._total0 = 0
 
+    def prime(self, reg: MetricsRegistry) -> None:
+        """Anchor the burn window at the registry's CURRENT counts, so
+        the first check() covers only observations made after this call —
+        the canary-rollout requirement (ISSUE 12): a canary must be
+        judged on its post-swap traffic, not on counter history from
+        before the rollout."""
+        self._err0 = reg.counter(self.err).value
+        self._total0 = reg.counter(self.total).value
+
     def check(self, reg: MetricsRegistry) -> Optional[Dict]:
         err = reg.counter(self.err).value
         total = reg.counter(self.total).value
@@ -181,6 +190,13 @@ class LatencyBurnRule(Rule):
         self.burn = float(burn)
         self.min_count = int(min_count)
         self._prev: Optional[List[int]] = None
+
+    def prime(self, reg: MetricsRegistry) -> None:
+        """Anchor the window at the histogram's current buckets (the
+        ErrorBurnRule.prime contract, for the same canary reason)."""
+        h = reg.histogram(self.hist)
+        with h._lock:
+            self._prev = list(h._buckets)
 
     def _over_and_total(self, h: Histogram) -> tuple:
         with h._lock:
@@ -226,6 +242,31 @@ def default_serving_rules(deadline_ms: Optional[float] = None,
         rules.append(LatencyBurnRule(
             "serve-latency-burn", hist="serve.e2e_ms",
             threshold=float(deadline_ms), objective=objective, burn=burn))
+    return rules
+
+
+def default_tenant_rules(tenant: str, deadline_ms: Optional[float] = None,
+                         objective: float = 0.05,
+                         burn: float = 2.0,
+                         min_total: int = 4) -> List[Rule]:
+    """Per-tenant burn rules over the fleet registry's `serve.tenant.<t>.*`
+    names (ISSUE 12): error burn (failed acks / submitted) always, e2e
+    latency burn when the tenant traffic carries a deadline. Rule names
+    are `tenant-<t>-...` so the FleetRouter can map an `alert:*` back to
+    the ONE tenant to shed (one tenant's burst sheds that tenant, not the
+    fleet)."""
+    prefix = "serve.tenant.%s." % tenant
+    rules: List[Rule] = [
+        ErrorBurnRule("tenant-%s-error-burn" % tenant,
+                      err=prefix + "failed", total=prefix + "submitted",
+                      objective=objective, burn=burn,
+                      min_total=min_total),
+    ]
+    if deadline_ms is not None:
+        rules.append(LatencyBurnRule(
+            "tenant-%s-latency-burn" % tenant, hist=prefix + "e2e_ms",
+            threshold=float(deadline_ms), objective=objective, burn=burn,
+            min_count=min_total))
     return rules
 
 

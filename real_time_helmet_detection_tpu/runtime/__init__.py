@@ -19,9 +19,9 @@ see CLAUDE.md and docs/ARCHITECTURE.md "Failure domains & supervision").
 from .errors import (EXIT_TRANSIENT, InjectedBackendError,  # noqa: F401
                      TrainingDivergenceError, classify_error_text,
                      classify_exception, is_transient_backend_error)
-from .faults import (ALL_SITES, FAULT_KINDS, SERVE_SITES,  # noqa: F401
-                     TRAIN_SITES, ChaosInjector, FaultEvent, FaultSchedule,
-                     maybe_injector)
+from .faults import (ALL_SITES, FAULT_KINDS, FLEET_SITES,  # noqa: F401
+                     SERVE_SITES, TRAIN_SITES, ChaosInjector, FaultEvent,
+                     FaultSchedule, maybe_injector)
 from .heartbeat import (FileHeartbeat, HangWatchdog,  # noqa: F401
                         heartbeat_age_s, maybe_job_heartbeat,
                         read_heartbeat, run_as_job, write_job_status)

@@ -74,16 +74,24 @@ FAULT_KINDS = ("device-loss", "hung-fetch", "slow-batch", "nan-batch",
 # the documented injection sites (callers may use others; these are the
 # instrumented ones and what seeded schedules draw from by default)
 SERVE_SITES = ("serve:dispatch", "serve:fetch")
+FLEET_SITES = ("fleet:dispatch", "fleet:replica")
 TRAIN_SITES = ("train:batch", "train:rank")
 LOADER_SITES = ("loader:batch", "loader:worker")
 ARTIFACT_SITES = ("artifact:write",)
-ALL_SITES = SERVE_SITES + TRAIN_SITES + LOADER_SITES + ARTIFACT_SITES
+ALL_SITES = (SERVE_SITES + FLEET_SITES + TRAIN_SITES + LOADER_SITES
+             + ARTIFACT_SITES)
 
 # which kinds make sense at which sites (seeded generation honors this;
 # parse() accepts anything — a hand-written schedule may be adversarial)
 SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     "serve:dispatch": ("device-loss", "slow-batch"),
     "serve:fetch": ("device-loss", "hung-fetch", "slow-batch"),
+    # the fleet router's own sites (ISSUE 12): a routing-layer dispatch
+    # failure (the replica's front door errors before the engine sees the
+    # request), and a whole-REPLICA death — the caller (FleetRouter)
+    # kills the selected replica abruptly and must respawn-and-requeue
+    "fleet:dispatch": ("device-loss", "slow-batch"),
+    "fleet:replica": ("worker-death",),
     "train:batch": ("nan-batch", "slow-batch"),
     # a data-parallel training RANK dies (ISSUE 11): the caller raises the
     # UNAVAILABLE signature so the surviving processes' job classifies

@@ -1,19 +1,25 @@
-"""Continuous-batching serving engine (ISSUE 8).
+"""Continuous-batching serving engine + multi-replica fleet (ISSUE 8/12).
 
 The reference's deployment story is a C++ app running the traced model one
 frame at a time (ref README.md:76); this package is the system around the
 jitted predict program that the reference never built: dynamic
-micro-batching into fixed-shape buckets, multiple in-flight batches, and
-admission control. See `engine.py` and docs/ARCHITECTURE.md "Serving
-engine".
+micro-batching into fixed-shape buckets, multiple in-flight batches,
+admission control (`engine.py`), and the multi-replica front door over N
+such engines — least-loaded dispatch, per-tenant budgets/SLOs, canary
+rollout, replica self-healing (`fleet.py`). See docs/ARCHITECTURE.md
+"Serving engine" and "Serving fleet".
 """
 
 from .engine import (CLOSED, DEFAULT_BUCKETS, DEGRADED, DRAINING, SERVING,
                      EngineClosedError, FetchHungError, ServeFuture,
                      ServingEngine, SheddedError, resolve_buckets)
+from .fleet import (DEFAULT_TENANT, PROMOTED, ROLLED_BACK, FleetFuture,
+                    FleetRouter, TenantSheddedError)
 
 __all__ = [
-    "CLOSED", "DEFAULT_BUCKETS", "DEGRADED", "DRAINING", "SERVING",
-    "EngineClosedError", "FetchHungError", "ServeFuture", "ServingEngine",
-    "SheddedError", "resolve_buckets",
+    "CLOSED", "DEFAULT_BUCKETS", "DEFAULT_TENANT", "DEGRADED", "DRAINING",
+    "PROMOTED", "ROLLED_BACK", "SERVING", "EngineClosedError",
+    "FetchHungError", "FleetFuture", "FleetRouter", "ServeFuture",
+    "ServingEngine", "SheddedError", "TenantSheddedError",
+    "resolve_buckets",
 ]

@@ -159,10 +159,17 @@ class ServeFuture:
     engine-close surfaces as the recorded exception. `t_submit`/`t_done`
     (monotonic) let load generators compute client-side latency without
     re-timing. Completion is FIRST-WINS: a hang-abandoned fetch that
-    eventually lands cannot overwrite the retry's result."""
+    eventually lands cannot overwrite the retry's result.
+
+    `add_done_callback(fn)` (ISSUE 12) is the fleet-router chaining hook:
+    `fn(self)` runs exactly once, on the completing thread (or inline
+    when already done) — the router uses it to re-dispatch a replica
+    failure to another replica without a polling thread. Callback
+    exceptions are swallowed (a completion must never kill the engine's
+    fetcher)."""
 
     __slots__ = ("_event", "_value", "_error", "t_submit", "t_done",
-                 "deadline")
+                 "deadline", "_cb", "_cb_lock", "_cb_fired")
 
     def __init__(self, deadline: Optional[float] = None):
         self._event = threading.Event()
@@ -171,6 +178,30 @@ class ServeFuture:
         self.t_submit = time.monotonic()
         self.t_done: Optional[float] = None
         self.deadline = deadline
+        self._cb = None
+        self._cb_lock = threading.Lock()
+        self._cb_fired = False
+
+    def _run_callback(self) -> None:
+        with self._cb_lock:
+            cb = self._cb
+            if cb is None or self._cb_fired:
+                return
+            self._cb_fired = True
+        try:
+            cb(self)
+        except Exception:  # noqa: BLE001 — see docstring
+            pass
+
+    def add_done_callback(self, fn) -> None:
+        """Register the ONE completion callback (last registration wins;
+        the engine itself registers none). Fires inline when the future
+        is already done — the submit-then-attach race is closed here,
+        not at the call site."""
+        with self._cb_lock:
+            self._cb = fn
+        if self._event.is_set():
+            self._run_callback()
 
     def _set(self, value) -> bool:
         if self._event.is_set():
@@ -178,6 +209,7 @@ class ServeFuture:
         self._value = value
         self.t_done = time.monotonic()
         self._event.set()
+        self._run_callback()
         return True
 
     def _fail(self, error: BaseException) -> bool:
@@ -186,10 +218,17 @@ class ServeFuture:
         self._error = error
         self.t_done = time.monotonic()
         self._event.set()
+        self._run_callback()
         return True
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def exception(self) -> Optional[BaseException]:
+        """The recorded error of a DONE future, else None — the
+        non-raising peek the fleet router's dispatch/redispatch decisions
+        read (concurrent.futures naming)."""
+        return self._error if self._event.is_set() else None
 
     def result(self, timeout: Optional[float] = None):
         if not self._event.wait(timeout):
@@ -380,6 +419,56 @@ class ServingEngine:
         self._set_state(CLOSED)
         self._m_writer.close()  # final metrics snapshot (when $OBS_METRICS)
 
+    def kill(self, reason: str = "replica death") -> int:
+        """Abrupt death (the `fleet:replica` chaos path, ISSUE 12): fail
+        every request still QUEUED (admission queue + retry deque) with
+        `EngineClosedError` NOW — they were acknowledged, so the caller
+        (FleetRouter) must re-dispatch them elsewhere — then shut the
+        threads down. Batches already dispatched cannot be un-dispatched;
+        they complete normally (first-wins futures), which mirrors a real
+        replica loss where in-flight device work may still land. Returns
+        the number of requests failed out of the queues. Idempotent."""
+        if self._closed:
+            return 0
+        self._closed = True
+        failed = 0
+        err = EngineClosedError("replica killed: %s" % str(reason)[:200])
+        # drain the admission queue ahead of the dispatcher: anything we
+        # win goes to the router's re-dispatch; anything the dispatcher
+        # wins is served (both end states keep the ack)
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req not in (_SENTINEL, _WAKE):
+                req.future._fail(err)
+                failed += 1
+        while self._retry:
+            self._retry.popleft().future._fail(err)
+            failed += 1
+        self._tracer.event("serve:killed", reason=str(reason)[:200],
+                           failed=failed)
+        if self._started:
+            self._q.put(_SENTINEL)
+            self._dispatcher.join()
+            self._fetcher.join()
+        # requests the dispatcher raced into the queue after our drain
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req not in (_SENTINEL, _WAKE):
+                req.future._fail(err)
+                failed += 1
+        while self._retry:
+            self._retry.popleft().future._fail(err)
+            failed += 1
+        self._set_state(CLOSED)
+        self._m_writer.close()
+        return failed
+
     def __enter__(self) -> "ServingEngine":
         return self
 
@@ -411,27 +500,44 @@ class ServingEngine:
         self._tracer.event("serve:degrade", reason=str(reason)[:200])
         self._set_state(DEGRADED)
 
-    def health(self) -> Dict:
+    def health(self, include_metrics: bool = True) -> Dict:
         """Point-in-time health snapshot (the load-balancer / chaos-suite
         API): state machine position, backlog depths, failure counters,
         plus the digested live metrics (per-stage latency p50/p99, fill
-        and depth gauges — ISSUE 10's extended health surface)."""
+        and depth gauges — ISSUE 10's extended health surface).
+
+        The whole digest is read under ONE `_lock` acquisition (ISSUE 12
+        bugfix: the state used to be read after the lock was released, so
+        a reload between the two reads could hand a load balancer a
+        `stats` snapshot from before the swap stitched to the state from
+        after it; `FleetRouter` consumes this on every dispatch, so the
+        snapshot must be internally consistent — pinned by
+        tests/test_fleet.py's single-acquisition test). The queue/retry
+        depth reads stay outside (queue.Queue carries its own lock; each
+        is an independently-atomic instantaneous depth — a tolerated,
+        documented skew, not an interleaved digest).
+
+        `include_metrics=False` is the dispatch fast path: the metrics
+        digest walks every histogram (quantile scans); a per-submit
+        router decision only needs the state/backlog fields."""
         with self._lock:
+            state = self._state
             stats = dict(self._stats)
             consec_fail = self._consecutive_failures
             inflight = self._inflight_batches
             last_error = self._last_error
-        out = {"state": self._state, "queued": self._q.qsize(),
+        out = {"state": state, "queued": self._q.qsize(),
                "retry_queued": len(self._retry),
                "inflight_batches": inflight,
                "consecutive_failures": consec_fail,
                "buckets": list(self._buckets),
                "max_retries": self._max_retries,
                "hang_timeout_s": self._hang_timeout_s,
-               "last_error": last_error, "stats": stats,
-               "metrics": self._metrics.digest(prefix="serve.")}
-        if self._watchdog is not None:
-            out["alerts"] = list(self._watchdog.alerts)
+               "last_error": last_error, "stats": stats}
+        if include_metrics:
+            out["metrics"] = self._metrics.digest(prefix="serve.")
+            if self._watchdog is not None:
+                out["alerts"] = list(self._watchdog.alerts)
         return out
 
     def _after_batch_outcome(self) -> None:
@@ -488,6 +594,14 @@ class ServingEngine:
     @property
     def buckets(self) -> Tuple[int, ...]:
         return self._buckets
+
+    @property
+    def metrics(self):
+        """This engine's MetricsRegistry — the canary watchdog's read
+        surface (FleetRouter builds its burn rules over the canary
+        replica's own registry, so the canary slice is judged on its own
+        counters, not the fleet's)."""
+        return self._metrics
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -1,0 +1,800 @@
+"""Serving fleet: a multi-replica router over ServingEngine replicas.
+
+The reference serves one frame per C++ invocation on one device (ref
+README.md:76); PR 8-10 built the single-node answer (ServingEngine: one
+chip's continuous-batching server). "Millions of users" is N chips behind
+a front door, and this module is that front door (ISSUE 12): a
+`FleetRouter` that fronts N ServingEngine replicas — in-process engines
+today (the relay is down; CPU replicas), remote chips or the C++ runner's
+per-bucket artifact dirs as further backend types later — behind the SAME
+submit/future API, so eval/bench/serve_bench code written against one
+engine drives a fleet unchanged.
+
+Design rules, each load-bearing:
+
+* **Least-loaded, deadline-aware dispatch over `health()` digests.** Every
+  submit scores each replica from its engine's consistent health snapshot
+  (`health(include_metrics=False)` — the ISSUE 12 single-lock digest):
+  score = queued + retry_queued + inflight_batches * max_bucket, i.e. an
+  upper bound on the new request's queue position, so minimizing the
+  score minimizes expected wait — which IS the deadline policy (a bounded
+  wait is the only thing a router can promise a deadline). DEGRADED
+  replicas carry a large additive penalty (they serve, last resort),
+  DRAINING a larger one (mid-reload), CLOSED are excluded outright. A
+  replica whose admission queue sheds the submit is skipped for the next
+  candidate; only when EVERY replica sheds does the fleet shed
+  (`fleet.shed_capacity`).
+* **Bounded cross-replica re-dispatch — acknowledged requests are never
+  lost.** The fleet future chains onto the replica future via
+  `ServeFuture.add_done_callback`: a replica-level failure (engine
+  closed/killed, retry budget exhausted, injected backend error)
+  re-dispatches the request to a different replica up to
+  `max_redispatch` times before the error is allowed to surface; a
+  deadline shed propagates as a shed (re-dispatching expired work wastes
+  bucket slots). This is the fleet's half of the zero-lost-acks
+  invariant the chaos suite pins: engine retries absorb batch faults,
+  router re-dispatch absorbs replica death.
+* **Per-tenant admission + SLO shed — one tenant's burst sheds that
+  tenant, not the fleet.** Each tenant key carries a token budget (max
+  outstanding admitted requests); submits over budget shed immediately
+  (`serve.tenant.<t>.shed`). Completion/latency land in per-tenant
+  `serve.tenant.<t>.*` counters/histograms on the fleet's obs.metrics
+  registry, and per-tenant `ErrorBurnRule`/`LatencyBurnRule` watchdogs
+  (obs/slo.py `default_tenant_rules`) run over them: an `alert:tenant-
+  <t>-*` puts THAT tenant in a deterministic penalty box (its next
+  `tenant_shed_requests` submits shed) while every other tenant routes
+  normally. Determinism: the box is counted in requests, not seconds, so
+  a chaos replay sheds the same requests.
+* **Canary rollout over the existing zero-downtime reload.**
+  `rollout(variables, canary_frac)` hot-swaps ONE replica via
+  `ServingEngine.reload` (engine.py — drains, swaps, zero recompiles),
+  then routes a deterministic `canary_frac` share of traffic to it
+  (counter-quota, not RNG: request k goes to the canary iff
+  floor(k*frac) > floor((k-1)*frac)). A watchdog armed over the CANARY
+  replica's own registry (burn windows primed at the swap, so pre-rollout
+  history never triggers) decides: `window` post-swap completions with
+  zero `alert:*` promotes the weights to every remaining replica (again
+  via reload — no request is dropped anywhere in the rollout), any alert
+  on the canary slice rolls the canary back to the stable weights
+  automatically. Rollout state rides flight-recorder events
+  (`fleet:rollout` / `fleet:promote` / `fleet:rollback`).
+* **Replica death is an input, not an outage.** The chaos sites
+  `fleet:dispatch` (routing-layer dispatch fault) and `fleet:replica`
+  (whole-replica death; runtime/faults.py) are fired on the submit path;
+  a worker-death kills the targeted replica abruptly
+  (`ServingEngine.kill` — queued acknowledged requests fail out NOW) and
+  the router respawns a fresh engine into the slot via the factory while
+  the killed requests re-dispatch to surviving replicas. Respawned
+  replicas are reloaded to the fleet's current stable weights, so a
+  death mid-rollout cannot resurrect stale weights.
+* **One metrics plane.** Fleet counters (`fleet.*`), per-tenant
+  (`serve.tenant.<t>.*`) and the per-replica engine registries are all
+  obs.metrics registries; `$OBS_METRICS` exports the fleet registry
+  exactly like the engine's, and `health()` returns the per-replica
+  digests + tenant/canary state a dashboard (or scripts/obs_report.py's
+  Fleet section) wants.
+
+Enforcement: graftlint's `ast/engine-bypass-in-fleet` flags raw
+ServingEngine construction or `.engine.submit(...)` calls in fleet/router
+code paths outside the two sanctioned points (`FleetRouter._spawn` and
+`FleetRouter._dispatch`) — fleet traffic goes through router dispatch, or
+the tenant/SLO/canary accounting silently lies.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .engine import (CLOSED, DEGRADED, DRAINING, EngineClosedError,
+                     ServingEngine, SheddedError)
+
+# additive dispatch-score penalties (in queue-position units): DEGRADED
+# replicas are a last resort, DRAINING ones are mid-reload and effectively
+# out of rotation unless nothing else serves
+PENALTY_DEGRADED = 1_000.0
+PENALTY_DRAINING = 1_000_000.0
+
+DEFAULT_TENANT = "default"
+
+_TENANT_RE = re.compile(r"[^A-Za-z0-9_-]")
+
+# rollout outcomes
+PROMOTED = "promoted"
+ROLLED_BACK = "rolled-back"
+ROLLOUT_TIMEOUT = "timeout"
+
+
+class TenantSheddedError(SheddedError):
+    """Shed by per-tenant admission (budget exhausted or the tenant's SLO
+    penalty box) — the fleet is healthy; THIS tenant is over its share."""
+
+
+def _sanitize_tenant(name: str) -> str:
+    return _TENANT_RE.sub("_", str(name)) or DEFAULT_TENANT
+
+
+class FleetFuture:
+    """Completion handle for one fleet request (the ServeFuture API —
+    `result()`/`done()`/`exception()`/`t_submit`/`t_done` — plus the
+    dispatch trail: `tenant`, `replicas` (rid per attempt) and
+    `redispatches`). First-wins like ServeFuture."""
+
+    __slots__ = ("_event", "_value", "_error", "t_submit", "t_done",
+                 "deadline", "tenant", "replicas", "redispatches")
+
+    def __init__(self, tenant: str, deadline: Optional[float] = None):
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self.t_submit = time.monotonic()
+        self.t_done: Optional[float] = None
+        self.deadline = deadline
+        self.tenant = tenant
+        self.replicas: List[int] = []
+        self.redispatches = 0
+
+    def _set(self, value) -> bool:
+        if self._event.is_set():
+            return False
+        self._value = value
+        self.t_done = time.monotonic()
+        self._event.set()
+        return True
+
+    def _fail(self, error: BaseException) -> bool:
+        if self._event.is_set():
+            return False
+        self._error = error
+        self.t_done = time.monotonic()
+        self._event.set()
+        return True
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def exception(self) -> Optional[BaseException]:
+        return self._error if self._event.is_set() else None
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("fleet request still pending after %ss"
+                               % timeout)
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _Replica:
+    __slots__ = ("rid", "engine", "generation")
+
+    def __init__(self, rid: int, engine: ServingEngine):
+        self.rid = rid
+        self.engine = engine
+        self.generation = 0
+
+
+class _Tenant:
+    __slots__ = ("name", "budget", "outstanding", "penalty",
+                 "c_submitted", "c_completed", "c_shed", "c_failed",
+                 "h_e2e")
+
+    def __init__(self, name: str, budget: int, mm):
+        self.name = name
+        self.budget = max(1, int(budget))
+        self.outstanding = 0
+        self.penalty = 0
+        prefix = "serve.tenant.%s." % name
+        self.c_submitted = mm.counter(prefix + "submitted")
+        self.c_completed = mm.counter(prefix + "completed")
+        self.c_shed = mm.counter(prefix + "shed")
+        self.c_failed = mm.counter(prefix + "failed")
+        self.h_e2e = mm.histogram(prefix + "e2e_ms")
+
+
+class _Request:
+    __slots__ = ("image", "future", "attempts")
+
+    def __init__(self, image: np.ndarray, future: FleetFuture):
+        self.image = image
+        self.future = future
+        self.attempts = 0  # re-dispatches consumed
+
+
+class FleetRouter:
+    """The fleet front door (see module docstring).
+
+    Parameters
+    ----------
+    replica_factory : Callable[[int, bool], ServingEngine]
+        `(rid, start) -> ServingEngine`; called N times at construction
+        (with `start=start`) and once per respawn (`start=True`). The
+        factory owns predict/variables/buckets; give each replica its OWN
+        MetricsRegistry so per-replica health digests stay per-replica.
+    n_replicas : fleet size (>= 1).
+    variables : the current stable checkpoint pytree — the rollback
+        target for canary rollouts (optional until `rollout` is used).
+    tenants : {tenant: budget} token budgets (max outstanding admitted
+        requests per tenant); unknown tenants are auto-created at
+        `default_budget`.
+    max_redispatch : per-REQUEST cross-replica re-dispatch budget after a
+        replica-level failure (0 = surface the first replica error).
+    deadline_ms : tenant latency-burn threshold (arms the per-tenant
+        LatencyBurnRule; None = error burn only).
+    tenant_shed_requests : penalty-box size after a tenant SLO alert
+        (default: that tenant's budget).
+    metrics : fleet obs.metrics registry (default: the process-wide one,
+        engine.py's convention).
+    watchdog_objective/burn : per-tenant + canary burn-rule tuning.
+    injector : runtime.faults.ChaosInjector for the `fleet:*` sites.
+    tracer : obs.spans tracer (default: $OBS_SPAN_LOG via maybe_tracer).
+    start : construct paused replicas (tests) — `start()` arms them.
+    """
+
+    def __init__(self, replica_factory: Callable[[int, bool],
+                                                 ServingEngine],
+                 n_replicas: int, variables=None,
+                 tenants: Optional[Dict[str, int]] = None,
+                 default_budget: int = 64, max_redispatch: int = 2,
+                 deadline_ms: Optional[float] = None,
+                 tenant_shed_requests: Optional[int] = None,
+                 metrics=None, watchdog_objective: float = 0.05,
+                 watchdog_burn: float = 2.0, injector=None, tracer=None,
+                 start: bool = True):
+        from ..obs import metrics as metrics_mod
+        from ..obs.slo import SloWatchdog, default_tenant_rules
+        from ..obs.spans import maybe_tracer
+
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1, got %d" % n_replicas)
+        self._factory = replica_factory
+        self._stable_variables = variables
+        self._max_redispatch = max(0, int(max_redispatch))
+        self._deadline_ms = deadline_ms
+        self._default_budget = max(1, int(default_budget))
+        self._tenant_shed_requests = tenant_shed_requests
+        self._objective = float(watchdog_objective)
+        self._burn = float(watchdog_burn)
+        self._injector = injector
+        self._tracer = tracer if tracer is not None else maybe_tracer()
+        self._metrics = (metrics if metrics is not None
+                         else metrics_mod.default_registry())
+        self._m_writer = metrics_mod.maybe_writer(registry=self._metrics)
+        mm = self._metrics
+        self._mc = {name: mm.counter("fleet." + name) for name in (
+            "submitted", "completed", "lost", "shed_tenant",
+            "shed_capacity", "shed_deadline", "redispatched",
+            "dispatch_faults", "replica_deaths", "respawns", "rollouts",
+            "promotes", "rollbacks")}
+        self._mg_replicas = mm.gauge("fleet.replicas")
+        self._mh_e2e = mm.histogram("fleet.e2e_ms")
+
+        self._lock = threading.Lock()
+        self._replicas: List[_Replica] = [
+            _Replica(rid, self._spawn(rid, start=start))
+            for rid in range(int(n_replicas))]
+        self._mg_replicas.set(len(self._replicas))
+        self._tenants: Dict[str, _Tenant] = {}
+        for name, budget in (tenants or {}).items():
+            t = _sanitize_tenant(name)
+            self._tenants[t] = _Tenant(t, budget, mm)
+        # ONE fleet watchdog over the per-tenant burn rules; alerts map
+        # back to the tenant by rule-name prefix (default_tenant_rules)
+        self._make_tenant_rules = lambda t: default_tenant_rules(
+            t, deadline_ms=self._deadline_ms, objective=self._objective,
+            burn=self._burn)
+        self._watchdog = SloWatchdog([], registry=mm, tracer=self._tracer)
+        for t in self._tenants.values():
+            self._watchdog.rules.extend(self._make_tenant_rules(t.name))
+        self._canary: Optional[_Replica] = None
+        self._canary_frac = 0.0
+        self._canary_k = 0
+        self._closing = False
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def _spawn(self, rid: int, start: bool = True) -> ServingEngine:
+        """THE sanctioned replica construction point (graftlint
+        ast/engine-bypass-in-fleet allowlists exactly this scope)."""
+        engine = self._factory(rid, start)
+        return engine
+
+    def start(self) -> None:
+        for rep in self._replicas:
+            rep.engine.start()
+
+    def close(self) -> None:
+        """Graceful fleet shutdown: stop re-dispatching, close every
+        replica (each drains its admitted work), final metrics flush.
+        Idempotent."""
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+        for rep in self._replicas:
+            try:
+                rep.engine.close()
+            except Exception:  # noqa: BLE001 — close every replica
+                pass
+        self._m_writer.close()
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- health ----------------------------------------------------------
+
+    @property
+    def replicas(self) -> int:
+        return len(self._replicas)
+
+    def health(self) -> Dict:
+        """Fleet digest: per-replica engine health (the consistent
+        snapshot, without per-replica metrics digests), tenant budgets /
+        penalty boxes, canary state and the fleet counters."""
+        with self._lock:
+            reps = list(self._replicas)
+            canary = self._canary
+            tenants = {t.name: {"budget": t.budget,
+                                "outstanding": t.outstanding,
+                                "penalty": t.penalty,
+                                "submitted": t.c_submitted.value,
+                                "completed": t.c_completed.value,
+                                "shed": t.c_shed.value,
+                                "failed": t.c_failed.value}
+                       for t in self._tenants.values()}
+        return {
+            "replicas": [dict(rid=rep.rid, generation=rep.generation,
+                              canary=(canary is rep),
+                              **rep.engine.health(include_metrics=False))
+                         for rep in reps],
+            "tenants": tenants,
+            "canary": (None if canary is None
+                       else {"rid": canary.rid,
+                             "frac": self._canary_frac}),
+            "counters": {("fleet." + k): c.value
+                         for k, c in sorted(self._mc.items())},
+            "alerts": list(self._watchdog.alerts),
+        }
+
+    def stats(self) -> Dict[str, int]:
+        return {k: c.value for k, c in self._mc.items()}
+
+    # ---- tenant admission ------------------------------------------------
+
+    def _tenant(self, name: str) -> _Tenant:
+        t = self._tenants.get(name)
+        if t is None:
+            t = self._tenants[name] = _Tenant(name, self._default_budget,
+                                              self._metrics)
+            self._watchdog.rules.extend(self._make_tenant_rules(name))
+        return t
+
+    def _tenant_alerts(self, fired: List[Dict]) -> None:
+        """Map fired `tenant-<t>-*` alerts to penalty boxes (called with
+        the router lock HELD)."""
+        for alert in fired:
+            rule = alert.get("rule", "")
+            if not rule.startswith("tenant-"):
+                continue
+            name = rule[len("tenant-"):].rsplit("-", 2)[0]
+            t = self._tenants.get(name)
+            if t is None:
+                continue
+            box = (self._tenant_shed_requests
+                   if self._tenant_shed_requests is not None
+                   else t.budget)
+            t.penalty = max(t.penalty, int(box))
+            self._tracer.event("fleet:tenant-shed", tenant=name,
+                               penalty=t.penalty, rule=rule)
+
+    # ---- dispatch --------------------------------------------------------
+
+    def _score(self, rep: _Replica):
+        """(score, state) for a routable replica, None for CLOSED."""
+        h = rep.engine.health(include_metrics=False)
+        state = h["state"]
+        if state == CLOSED:
+            return None
+        score = float(h["queued"] + h["retry_queued"]
+                      + h["inflight_batches"] * rep.engine.buckets[-1])
+        if state == DEGRADED:
+            score += PENALTY_DEGRADED
+        elif state == DRAINING:
+            score += PENALTY_DRAINING
+        return score, state
+
+    def _candidates(self, exclude_engines: set,
+                    to_canary: bool) -> List[_Replica]:
+        """Replicas in dispatch order: canary-first for the canary slice,
+        else least-loaded among non-canary (canary excluded from the
+        non-canary share so its observation window stays ~frac), with
+        every non-CLOSED replica as fallback so a full/dead primary never
+        strands a request the fleet could still serve. DRAINING replicas
+        are dropped outright whenever anything else is routable: a
+        mid-reload engine must be able to run dry — routing into its
+        drain would stall the reload under sustained load (it stays the
+        last resort only when the whole fleet is draining)."""
+        with self._lock:
+            reps = list(self._replicas)
+            canary = self._canary
+        scored = []
+        for rep in reps:
+            if id(rep.engine) in exclude_engines:
+                continue
+            ss = self._score(rep)
+            if ss is None:
+                continue
+            scored.append((ss[0], rep.rid, rep, ss[1]))
+        scored.sort(key=lambda x: (x[0], x[1]))
+        if any(state != DRAINING for _, _, _, state in scored):
+            scored = [row for row in scored if row[3] != DRAINING]
+        ordered = [rep for _, _, rep, _ in scored]
+        if canary is not None and canary in ordered:
+            if to_canary:
+                ordered.remove(canary)
+                ordered.insert(0, canary)
+            else:
+                # non-canary share: canary only as the last resort
+                ordered.remove(canary)
+                ordered.append(canary)
+        return ordered
+
+    def _dispatch(self, req: _Request, exclude_engines: set,
+                  to_canary: bool = False) -> bool:
+        """Try candidates in order until one admits the request; chain
+        the fleet future onto the replica future. False = nobody
+        admitted (fleet capacity shed). THE sanctioned engine-submit
+        point (graftlint ast/engine-bypass-in-fleet)."""
+        if self._injector is not None:
+            try:
+                self._injector.fire("fleet:dispatch")
+            except Exception as e:  # noqa: BLE001 — routing-layer fault
+                self._mc["dispatch_faults"].inc()
+                self._tracer.event("fleet:dispatch-fault",
+                                   error=type(e).__name__)
+                # transient front-door fault: the request is still ours;
+                # fall through and route it (bounded by the schedule)
+        fut = req.future
+        remaining = (None if fut.deadline is None
+                     else fut.deadline - time.monotonic())
+        if remaining is not None and remaining <= 0:
+            self._shed(req, "deadline", SheddedError(
+                "deadline passed before fleet dispatch"))
+            return True  # resolved (as a shed), not a capacity miss
+        for rep in self._candidates(exclude_engines, to_canary):
+            eng = rep.engine  # pin: a respawn may swap rep.engine later
+            try:
+                sf = eng.submit(req.image, deadline_s=remaining,
+                                block=False)
+            except EngineClosedError:
+                continue  # raced a death; next candidate
+            err = sf.exception()
+            if err is not None and isinstance(err, SheddedError):
+                continue  # this replica's queue is full; next candidate
+            fut.replicas.append(rep.rid)
+            self._tracer.event("fleet:dispatch", rid=rep.rid,
+                               tenant=fut.tenant)
+            sf.add_done_callback(
+                lambda f, req=req, rid=rep.rid, eng=eng:
+                self._on_replica_done(req, rid, eng, f))
+            return True
+        return False
+
+    def _shed(self, req: _Request, reason: str,
+              error: SheddedError) -> None:
+        fut = req.future
+        if not fut._fail(error):
+            return
+        with self._lock:
+            t = self._tenant(fut.tenant)
+            t.outstanding = max(0, t.outstanding - 1)
+            t.c_shed.inc()
+        self._mc["shed_deadline" if reason == "deadline"
+                 else "shed_capacity"].inc()
+        self._tracer.event("fleet:shed", reason=reason, tenant=fut.tenant)
+
+    def _on_replica_done(self, req: _Request, rid: int, engine,
+                         sf) -> None:
+        """Replica future completed: success -> complete + account;
+        deadline shed -> propagate; replica failure -> bounded
+        re-dispatch elsewhere, else the error surfaces (a lost ack).
+        `engine` is the engine the request FAILED ON (pinned at dispatch
+        — after a respawn the slot holds a fresh engine that must remain
+        a re-dispatch candidate, single-replica fleets included)."""
+        fut = req.future
+        err = sf.exception()
+        if err is None:
+            if fut._set(sf._value):
+                e2e_ms = (fut.t_done - fut.t_submit) * 1e3
+                with self._lock:
+                    t = self._tenant(fut.tenant)
+                    t.outstanding = max(0, t.outstanding - 1)
+                    t.c_completed.inc()
+                    t.h_e2e.observe(e2e_ms)
+                    fired = self._watchdog.check()
+                    self._tenant_alerts(fired)
+                self._mc["completed"].inc()
+                self._mh_e2e.observe(e2e_ms)
+                self._m_writer.maybe_flush()
+            return
+        if isinstance(err, SheddedError):
+            # the engine shed on DEADLINE (fleet admission already
+            # happened): propagate — expired work is not re-dispatched
+            self._shed(req, "deadline", err)
+            return
+        # replica-level failure: re-dispatch within budget and deadline
+        closing = self._closing
+        if (not closing) and req.attempts < self._max_redispatch:
+            req.attempts += 1
+            fut.redispatches += 1
+            self._mc["redispatched"].inc()
+            self._tracer.event("fleet:redispatch", rid=rid,
+                               attempt=req.attempts,
+                               error=type(err).__name__)
+            if self._dispatch(req, exclude_engines={id(engine)}):
+                return
+            # nobody could take it: fall through to surface the error
+        if fut._fail(err):
+            with self._lock:
+                t = self._tenant(fut.tenant)
+                t.outstanding = max(0, t.outstanding - 1)
+                t.c_failed.inc()
+                fired = self._watchdog.check()
+                self._tenant_alerts(fired)
+            self._mc["lost"].inc()
+            self._tracer.event("fleet:lost", tenant=fut.tenant,
+                               error=type(err).__name__)
+
+    # ---- client API ------------------------------------------------------
+
+    def submit(self, image: np.ndarray, tenant: str = DEFAULT_TENANT,
+               deadline_s: Optional[float] = None,
+               block: bool = False) -> FleetFuture:
+        """Route one request. Admission is per-tenant (budget + penalty
+        box) then per-fleet (every replica's queue full => capacity
+        shed); an admitted request is ACKNOWLEDGED — it completes with a
+        result or a surfaced error, through re-dispatch if its replica
+        dies (the chaos suite's fleet invariant). Never blocks on a
+        replica queue (engine submits use block=False — blocking the
+        router on one replica would stall every tenant); the `block`
+        parameter exists for ServingEngine.submit API compatibility (the
+        serve_bench load loops drive either) and is ignored."""
+        del block  # API-compat only: a router shed is always immediate
+        if self._closing:
+            raise EngineClosedError("fleet router closed")
+        tenant = _sanitize_tenant(tenant)
+        fut = FleetFuture(tenant, deadline=None if deadline_s is None
+                          else time.monotonic() + float(deadline_s))
+        req = _Request(np.asarray(image), fut)
+        self._mc["submitted"].inc()
+        # fleet:replica chaos: a worker-death kills the replica the
+        # request WOULD have routed to (submit path only — never from an
+        # engine-thread callback, where killing would self-join)
+        if self._injector is not None:
+            ev = self._injector.fire("fleet:replica")
+            if ev is not None and ev.kind == "worker-death":
+                self._kill_least_loaded()
+        with self._lock:
+            t = self._tenant(tenant)
+            t.c_submitted.inc()
+            if t.penalty > 0:
+                t.penalty -= 1
+                t.c_shed.inc()
+                fut._fail(TenantSheddedError(
+                    "tenant %s in SLO penalty box" % tenant))
+                self._mc["shed_tenant"].inc()
+                shed_reason = "tenant-slo"
+            elif t.outstanding >= t.budget:
+                t.c_shed.inc()
+                fut._fail(TenantSheddedError(
+                    "tenant %s over budget (%d outstanding)"
+                    % (tenant, t.outstanding)))
+                self._mc["shed_tenant"].inc()
+                shed_reason = "tenant-budget"
+            else:
+                t.outstanding += 1
+                shed_reason = None
+            if self._canary is not None:
+                self._canary_k += 1
+                k = self._canary_k
+                to_canary = (int(k * self._canary_frac)
+                             != int((k - 1) * self._canary_frac))
+            else:
+                to_canary = False
+        if shed_reason is not None:
+            self._tracer.event("fleet:shed", reason=shed_reason,
+                               tenant=tenant)
+            return fut
+        if not self._dispatch(req, exclude_engines=set(),
+                              to_canary=to_canary):
+            self._shed(req, "capacity", SheddedError(
+                "every replica shed (fleet at capacity)"))
+        return fut
+
+    def predict_many(self, images: Sequence[np.ndarray],
+                     tenant: str = DEFAULT_TENANT) -> List:
+        futs = [self.submit(img, tenant=tenant) for img in images]
+        return [f.result() for f in futs]
+
+    # ---- replica death / respawn -----------------------------------------
+
+    def _kill_least_loaded(self) -> None:
+        with self._lock:
+            reps = list(self._replicas)
+        best = None
+        for rep in reps:
+            ss = self._score(rep)
+            if ss is not None and (best is None or ss[0] < best[0]):
+                best = (ss[0], rep)
+        if best is not None:
+            self.kill_replica(best[1].rid, reason="fault: worker-death")
+
+    def kill_replica(self, rid: int, reason: str = "killed") -> None:
+        """Abrupt replica death + respawn-and-requeue (the
+        `fleet:replica` recovery path; also the chaos tests' lever). The
+        fresh engine is swapped into the slot BEFORE the old one is
+        killed, so the killed requests' re-dispatch callbacks always see
+        a live fleet — single-replica fleets heal too."""
+        with self._lock:
+            rep = next((r for r in self._replicas if r.rid == rid), None)
+            if rep is None:
+                raise ValueError("no replica %d" % rid)
+            old = rep.engine
+            canary_died = self._canary is rep
+        self._mc["replica_deaths"].inc()
+        self._tracer.event("fleet:replica-death", rid=rid,
+                           reason=str(reason)[:200])
+        fresh = self._spawn(rid, start=True)
+        if self._stable_variables is not None:
+            # a respawn mid-rollout (or post-promote) must not resurrect
+            # the factory's original weights
+            fresh.reload(self._stable_variables)
+        with self._lock:
+            rep.engine = fresh
+            rep.generation += 1
+            if canary_died:
+                self._canary = None  # rollout poll sees the death
+        old.kill(reason)  # queued acks fail -> callbacks re-dispatch
+        self._mc["respawns"].inc()
+        self._tracer.event("fleet:respawn", rid=rid,
+                           generation=rep.generation)
+
+    # ---- canary rollout --------------------------------------------------
+
+    def rollout(self, variables, canary_frac: float = 0.25,
+                window: int = 16, timeout_s: float = 60.0,
+                poll_s: float = 0.002) -> Dict:
+        """Canary rollout (module docstring): swap ONE replica to
+        `variables`, watch `window` post-swap completions on the canary
+        slice, promote to the rest on a clean window, roll back on any
+        canary `alert:*` (or canary death). Blocking control path —
+        traffic flows from other threads meanwhile (mirrors
+        engine.drain's polling discipline). Returns the outcome dict."""
+        from ..obs.slo import (ErrorBurnRule, LatencyBurnRule,
+                               SloWatchdog)
+        if self._stable_variables is None:
+            raise ValueError("rollout needs the stable checkpoint: "
+                             "construct FleetRouter(variables=...)")
+        with self._lock:
+            if self._canary is not None:
+                raise RuntimeError("a rollout is already in progress")
+            reps = list(self._replicas)
+        frac = min(1.0, max(0.0, float(canary_frac)))
+        # deterministic pick: healthiest (lowest score), lowest rid
+        scored = sorted((ss[0], r.rid, r) for ss, r in
+                        ((self._score(r), r) for r in reps)
+                        if ss is not None)
+        if not scored:
+            raise EngineClosedError("no live replica to canary")
+        canary = scored[0][2]
+        rules = [ErrorBurnRule("canary-error-burn",
+                               err="serve.failed_batches",
+                               total="serve.batches_total",
+                               objective=self._objective, burn=self._burn,
+                               min_total=1)]
+        if self._deadline_ms is not None:
+            rules.append(LatencyBurnRule(
+                "canary-latency-burn", hist="serve.e2e_ms",
+                threshold=self._deadline_ms, objective=self._objective,
+                burn=self._burn, min_count=max(1, window // 4)))
+        creg = canary.engine.metrics
+        for rule in rules:
+            rule.prime(creg)  # post-swap window only
+        wd = SloWatchdog(rules, registry=creg, tracer=self._tracer)
+        c0 = creg.counter("serve.completed").value
+        self._mc["rollouts"].inc()
+        self._tracer.event("fleet:rollout", rid=canary.rid, frac=frac,
+                           window=window)
+        canary.engine.reload(variables)
+        with self._lock:
+            self._canary = canary
+            self._canary_frac = frac
+            self._canary_k = 0
+        outcome = ROLLOUT_TIMEOUT
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        try:
+            while time.monotonic() < deadline:
+                with self._lock:
+                    died = self._canary is not canary
+                fired = [] if died else wd.check()
+                if died or fired or canary.engine.state == CLOSED:
+                    died = died or canary.engine.state == CLOSED
+                    reason = ("replica-death" if died
+                              else fired[0].get("rule", "alert"))
+                    outcome = ROLLED_BACK
+                    self._end_canary(canary)
+                    self._rollback(canary, died, reason, wd)
+                    break
+                done = creg.counter("serve.completed").value - c0
+                if done >= max(1, int(window)):
+                    outcome = PROMOTED
+                    self._end_canary(canary)
+                    self._promote(canary, variables)
+                    break
+                time.sleep(poll_s)
+            else:
+                # observation window never filled: fail safe — back out
+                outcome = ROLLED_BACK
+                self._end_canary(canary)
+                self._rollback(canary, False, "window-timeout", wd)
+        finally:
+            with self._lock:
+                if self._canary is canary:
+                    self._canary = None
+                self._canary_frac = 0.0
+        return {"outcome": outcome, "canary": canary.rid,
+                "observed": creg.counter("serve.completed").value - c0,
+                "alerts": list(wd.alerts)}
+
+    def _end_canary(self, canary: _Replica) -> None:
+        """Stop canary-share routing BEFORE the promote/rollback reloads:
+        the reloading engines must run dry, and a canary-first split
+        would keep feeding the one being drained."""
+        with self._lock:
+            if self._canary is canary:
+                self._canary = None
+            self._canary_frac = 0.0
+
+    def _reload_or_respawn(self, rep: _Replica, variables) -> None:
+        """Swap a replica's weights, with the death path as the fallback:
+        a reload whose drain times out (a replica wedged under sustained
+        saturation) is resolved by kill+respawn — the fresh engine starts
+        at the CURRENT stable weights, so either path converges and a
+        rollout can never strand a replica on the outgoing checkpoint."""
+        if rep.engine.state == CLOSED:
+            return
+        try:
+            rep.engine.reload(variables)
+        except TimeoutError:
+            self._tracer.event("fleet:reload-timeout", rid=rep.rid)
+            self.kill_replica(rep.rid, reason="reload drain timeout")
+
+    def _promote(self, canary: _Replica, variables) -> None:
+        with self._lock:
+            others = [r for r in self._replicas if r is not canary]
+        # stable flips FIRST: a respawn fallback (or a concurrent death)
+        # during the fan-out must come up on the NEW weights
+        self._stable_variables = variables
+        for rep in others:
+            self._reload_or_respawn(rep, variables)
+        self._mc["promotes"].inc()
+        self._tracer.event("fleet:promote", rid=canary.rid,
+                           replicas=len(others) + 1)
+
+    def _rollback(self, canary: _Replica, died: bool, reason: str,
+                  wd) -> None:
+        if not died:
+            self._reload_or_respawn(canary, self._stable_variables)
+        # a dead canary was already respawned at the STABLE weights by
+        # kill_replica — the rollback is the respawn itself
+        self._mc["rollbacks"].inc()
+        self._tracer.event("fleet:rollback", rid=canary.rid,
+                           reason=str(reason)[:200],
+                           alerts=len(wd.alerts))

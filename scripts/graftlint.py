@@ -269,6 +269,26 @@ AST_FIXTURES = {
 }
 
 
+FLEET_FIXTURES = {
+    # the fleet bypass rule renders at a serving/fleet path (ISSUE 12)
+    "engine-bypass-in-fleet": (
+        # a fleet module constructing a raw engine and submitting to a
+        # replica's engine directly — the tenant/SLO/canary accounting
+        # never sees that traffic
+        "def route(predict, variables, replicas, image):\n"
+        "    spare = ServingEngine(predict, variables, (64, 64, 3),\n"
+        "                          'uint8')\n"
+        "    return replicas[0].engine.submit(image)\n",
+        # the sanctioned shape: construction through the factory, traffic
+        # through router dispatch
+        "def route(router, image):\n"
+        "    return router.submit(image, tenant='bulk')\n"
+        "def spawn(factory, rid):\n"
+        "    return factory(rid, True)\n",
+    ),
+}
+
+
 SERVING_FIXTURES = {
     # rules scoped to the serving package render at a serving/ path
     "device-get-in-serving-loop": (
@@ -314,6 +334,25 @@ def _selfcheck_ast(check) -> None:
         check("%s scoped to serving/" % rule,
               not any(f.rule == rule for f in ast_rules.lint_source(
                   bad, "scripts/fixture_scope.py")))
+    for short, (bad, good) in FLEET_FIXTURES.items():
+        rule = "ast/" + short
+        fpath = ast_rules.SERVING_PREFIX + "fleet_fixture_%s.py"
+        bad_f = ast_rules.lint_source(bad, fpath % "bad")
+        good_f = ast_rules.lint_source(good, fpath % "good")
+        check("%s fires on bad fixture" % rule,
+              any(f.rule == rule for f in bad_f))
+        check("%s silent on good fixture" % rule,
+              not any(f.rule == rule for f in good_f))
+        # out-of-scope twin: the same bad source in a module that neither
+        # lives at a fleet path nor references FleetRouter must not fire
+        check("%s scoped to fleet code paths" % rule,
+              not any(f.rule == rule for f in ast_rules.lint_source(
+                  bad, "scripts/fixture_scope.py")))
+        # ...but ANY module referencing FleetRouter is in scope
+        check("%s follows FleetRouter references" % rule,
+              any(f.rule == rule for f in ast_rules.lint_source(
+                  "from real_time_helmet_detection_tpu.serving import "
+                  "FleetRouter\n" + bad, "scripts/fixture_router.py")))
     # suppression marker: the bad fixture plus an inline off= goes silent
     bad = AST_FIXTURES["raw-artifact-write"][0].replace(
         "'w') as f:", "'w') as f:  # graftlint: off=raw-artifact-write")

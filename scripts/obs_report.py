@@ -56,12 +56,14 @@ from real_time_helmet_detection_tpu.obs.spans import (  # noqa: E402
 from real_time_helmet_detection_tpu.utils import (  # noqa: E402
     atomic_write_bytes, save_json)
 
-SCHEMA = "obs-report-v3"
-READABLE_SCHEMAS = ("obs-report-v1", "obs-report-v2", "obs-report-v3")
-# sections older schemas lack; read_report nulls them (v1 lacks both
-# groups, v2 lacks the v3 Scaling section)
+SCHEMA = "obs-report-v4"
+READABLE_SCHEMAS = ("obs-report-v1", "obs-report-v2", "obs-report-v3",
+                    "obs-report-v4")
+# sections older schemas lack; read_report nulls them (v1 lacks every
+# group, v2 lacks Scaling + Fleet, v3 lacks Fleet)
 V2_SECTIONS = ("metrics", "slo")
 V3_SECTIONS = ("scaling",)
+V4_SECTIONS = ("fleet",)
 
 
 def read_report(path: str) -> Optional[Dict]:
@@ -78,7 +80,7 @@ def read_report(path: str) -> Optional[Dict]:
     if rep.get("schema") not in READABLE_SCHEMAS:
         log("unreadable report schema %r in %s" % (rep.get("schema"), path))
         return None
-    for section in V2_SECTIONS + V3_SECTIONS:
+    for section in V2_SECTIONS + V3_SECTIONS + V4_SECTIONS:
         rep.setdefault(section, None)
     return rep
 
@@ -360,6 +362,67 @@ def summarize_scaling(paths: List[str],
     return {"files": files, "spans": span_digest}
 
 
+def summarize_fleet(paths: List[str]) -> Optional[Dict]:
+    """The Fleet section (ISSUE 12): per-replica dispatch counts, the
+    replica lifecycle (deaths/respawns/reload-timeouts), per-tenant shed
+    accounting, and the canary rollout events joined against `alert:*`
+    and `fault:*` in one timeline — a post-mortem reads which replica a
+    canary was, what the watchdog saw on its slice, and whether the
+    promote/rollback decision lined up with the injected (or real)
+    failures. Returns None when the round recorded no fleet activity."""
+    by_replica: Dict[str, int] = {}
+    shed: Dict[str, int] = {}
+    tenants_shed: Dict[str, int] = {}
+    lifecycle: Dict[str, int] = {}
+    rollouts: Dict[str, int] = {}
+    redispatches = lost = 0
+    timeline: List[Dict] = []
+    for path in paths:
+        for rec in read_spans(path):
+            name = rec.get("name", "")
+            meta = rec.get("meta") or {}
+            t = rec.get("t")
+            if name.startswith("fleet:"):
+                what = name[len("fleet:"):]
+                if what == "dispatch":
+                    rid = str(meta.get("rid", "?"))
+                    by_replica[rid] = by_replica.get(rid, 0) + 1
+                    continue  # per-dispatch records stay out of the
+                    # timeline (volume)
+                if what == "redispatch":
+                    redispatches += 1
+                elif what == "lost":
+                    lost += 1
+                elif what == "shed":
+                    reason = meta.get("reason", "?")
+                    shed[reason] = shed.get(reason, 0) + 1
+                elif what == "tenant-shed":
+                    tenant = meta.get("tenant", "?")
+                    tenants_shed[tenant] = tenants_shed.get(tenant, 0) + 1
+                elif what in ("replica-death", "respawn",
+                              "reload-timeout", "killed"):
+                    lifecycle[what] = lifecycle.get(what, 0) + 1
+                elif what in ("rollout", "promote", "rollback"):
+                    rollouts[what] = rollouts.get(what, 0) + 1
+                label = name
+                if "rid" in meta:
+                    label += " rid=%s" % meta["rid"]
+                if "reason" in meta:
+                    label += " (%s)" % meta["reason"]
+                timeline.append({"t": t, "what": "fleet", "name": label})
+            elif name.startswith(("alert:", "fault:")):
+                timeline.append({"t": t, "what": name.split(":", 1)[0],
+                                 "name": name})
+    if not (by_replica or lifecycle or rollouts or shed or redispatches):
+        return None
+    timeline.sort(key=lambda r: (r.get("t") is None, r.get("t")))
+    return {"dispatches_by_replica": dict(sorted(by_replica.items())),
+            "dispatches_total": sum(by_replica.values()),
+            "redispatches": redispatches, "lost": lost, "shed": shed,
+            "tenants_shed": tenants_shed, "lifecycle": lifecycle,
+            "rollouts": rollouts, "timeline": timeline}
+
+
 def summarize_queue(queue_dir: Optional[str]) -> Optional[Dict]:
     """Read-only tolerant replay of the job journal: per-job final state,
     attempts, salvage evidence, queued->terminal wall seconds."""
@@ -476,6 +539,7 @@ def build_report(round_name: str, span_paths: List[str],
         "metrics": summarize_metrics(metrics_paths or []),
         "slo": summarize_slo(span_paths),
         "scaling": summarize_scaling(scaling_paths or [], span_paths),
+        "fleet": summarize_fleet(span_paths),
         "queue": summarize_queue(queue_dir),
         "bench": summarize_bench(bench_paths),
         "loss": summarize_loss_log(loss_paths),
@@ -644,6 +708,44 @@ def render_markdown(rep: Dict) -> str:
     else:
         lines.append("_no scaling activity recorded_")
     lines += [""]
+    ft = rep.get("fleet")
+    lines += ["## Fleet", ""]
+    if ft:
+        lines += ["%d dispatch(es) over %d replica(s): %s; "
+                  "redispatches %d, lost %d"
+                  % (ft["dispatches_total"],
+                     len(ft["dispatches_by_replica"]),
+                     (", ".join("rid %s ×%d" % (k, v) for k, v in
+                                ft["dispatches_by_replica"].items())
+                      or "-"),
+                     ft["redispatches"], ft["lost"]), ""]
+        if ft["shed"] or ft["tenants_shed"]:
+            lines += ["Shed: %s%s" % (
+                (", ".join("%s ×%d" % (k, v)
+                           for k, v in sorted(ft["shed"].items()))
+                 or "none"),
+                ("; tenant penalty boxes: " + ", ".join(
+                    "%s ×%d" % (k, v)
+                    for k, v in sorted(ft["tenants_shed"].items()))
+                 if ft["tenants_shed"] else "")), ""]
+        if ft["lifecycle"]:
+            lines += ["Replica lifecycle: " + ", ".join(
+                "%s ×%d" % (k, v)
+                for k, v in sorted(ft["lifecycle"].items())), ""]
+        if ft["rollouts"]:
+            lines += ["Canary: " + ", ".join(
+                "%s ×%d" % (k, v)
+                for k, v in sorted(ft["rollouts"].items())), ""]
+        if ft["timeline"]:
+            lines += ["| t | what | event |", "|---|---|---|"]
+            for ev in ft["timeline"]:
+                lines.append("| %s | %s | %s |"
+                             % (("%.3f" % ev["t"]) if isinstance(
+                                 ev.get("t"), (int, float)) else "?",
+                                ev["what"], ev["name"]))
+    else:
+        lines.append("_no fleet activity recorded_")
+    lines += [""]
     q = rep["queue"]
     lines += ["## Queue", ""]
     if q:
@@ -786,6 +888,23 @@ def selfcheck() -> int:
         tracer.record("scale:compile", 2.5, program="d8")
         tracer.record("scale:barrier", 0.2, program="d8")
         tracer.record("scale:step", 0.4, devices=8, world=2)
+        # fleet taxonomy (ISSUE 12): dispatch counts per replica, a
+        # tenant penalty box, a replica death/respawn arc and a canary
+        # rollout that rolls back — the Fleet section's joins
+        tracer.event("fleet:dispatch", rid=0, tenant="bulk")
+        tracer.event("fleet:dispatch", rid=0, tenant="flagged")
+        tracer.event("fleet:dispatch", rid=1, tenant="bulk")
+        tracer.event("fleet:shed", reason="tenant-budget", tenant="bulk")
+        tracer.event("fleet:tenant-shed", tenant="bulk", penalty=2,
+                     rule="tenant-bulk-latency-burn")
+        tracer.event("fleet:rollout", rid=1, frac=0.25, window=16)
+        tracer.event("fleet:replica-death", rid=0,
+                     reason="fault: worker-death")
+        tracer.event("fleet:respawn", rid=0, generation=1)
+        tracer.event("fleet:redispatch", rid=0, attempt=1,
+                     error="EngineClosedError")
+        tracer.event("fleet:rollback", rid=1, reason="canary-error-burn",
+                     alerts=1)
         tracer.close()
         with open(span_path, "a") as f:  # graftlint: off=raw-artifact-write
             f.write('{"kind": "span", "torn')  # kill -9 mid-append twin
@@ -880,9 +999,9 @@ def selfcheck() -> int:
         check("schema tagged", rep["schema"] == SCHEMA)
         sp = rep["spans"]
         check("torn span tail dropped, all real records read",
-              sp["records"] == 39)  # meta + 4 steps + ckpt + hb + ctx
+              sp["records"] == 49)  # meta + 4 steps + ckpt + hb + ctx
         # + 16 serve spans + shed event + 7 fault/recover events +
-        # reload span + 2 alert events + 4 scale spans
+        # reload span + 2 alert events + 4 scale spans + 10 fleet events
         check("step span stats", sp["by_name"].get("step", {}).get(
             "count") == 4 and abs(sp["by_name"]["step"]["total_s"]
                                   - 0.1) < 1e-6)
@@ -949,6 +1068,24 @@ def selfcheck() -> int:
               scl["spans"].get("compile", {}).get("count") == 2
               and abs(scl["spans"]["compile"]["total_s"] - 4.0) < 1e-6
               and scl["spans"].get("barrier", {}).get("count") == 1)
+        ft = rep["fleet"]
+        check("fleet section joined", ft is not None
+              and ft["dispatches_by_replica"] == {"0": 2, "1": 1}
+              and ft["dispatches_total"] == 3
+              and ft["redispatches"] == 1
+              and ft["shed"] == {"tenant-budget": 1}
+              and ft["tenants_shed"] == {"bulk": 1})
+        check("fleet lifecycle + canary joined",
+              ft["lifecycle"] == {"replica-death": 1, "respawn": 1}
+              and ft["rollouts"] == {"rollout": 1, "rollback": 1})
+        ft_names = [ev["name"] for ev in ft["timeline"]]
+        check("fleet timeline joins alerts + faults",
+              "fault:device-loss" in ft_names
+              and any(n.startswith("alert:") for n in ft_names)
+              and any(n.startswith("fleet:rollout") for n in ft_names)
+              and (ft_names.index("fleet:rollout rid=1")
+                   < ft_names.index(
+                       "fleet:rollback rid=1 (canary-error-burn)")))
         q = rep["queue"]
         check("queue states joined", q is not None
               and q["jobs"]["bench"]["state"] == "done"
@@ -981,6 +1118,10 @@ def selfcheck() -> int:
         check("markdown carries scaling section",
               "## Scaling" in md and "| 8 | 2 |" in md
               and "0.91" in md and "Harness spans:" in md)
+        check("markdown carries fleet section",
+              "## Fleet" in md and "rid 0 ×2" in md
+              and "replica-death ×1" in md and "rollback ×1" in md
+              and "tenant penalty boxes: bulk ×1" in md)
 
         # schema compat: the generated v2 report reads back through
         # read_report, and a committed v1 report (a pre-ISSUE-10 round)
@@ -998,8 +1139,9 @@ def selfcheck() -> int:
         check("v1 report readable with v2 sections nulled",
               v1 is not None and v1["metrics"] is None
               and v1["slo"] is None and v1["scaling"] is None
+              and v1["fleet"] is None
               and v1["spans"]["records"] == 3)
-        # a committed v2 report (pre-ISSUE-11 round) nulls only Scaling
+        # a committed v2 report (pre-ISSUE-11 round) nulls Scaling+Fleet
         v2_path = os.path.join(tmp, "report_v2.json")
         atomic_write_bytes(v2_path, json.dumps(
             {"schema": "obs-report-v2", "round": "r12",
@@ -1008,8 +1150,21 @@ def selfcheck() -> int:
         v2 = read_report(v2_path)
         check("v2 report readable with scaling nulled",
               v2 is not None and v2["scaling"] is None
+              and v2["fleet"] is None
               and v2["metrics"] is not None
               and v2["spans"]["records"] == 5)
+        # a committed v3 report (pre-ISSUE-12 round) nulls only Fleet
+        v3_path = os.path.join(tmp, "report_v3.json")
+        atomic_write_bytes(v3_path, json.dumps(
+            {"schema": "obs-report-v3", "round": "r13",
+             "metrics": {"files": []}, "slo": None,
+             "scaling": {"files": [], "spans": {}},
+             "spans": {"records": 7}}).encode())
+        v3 = read_report(v3_path)
+        check("v3 report readable with fleet nulled",
+              v3 is not None and v3["fleet"] is None
+              and v3["scaling"] is not None
+              and v3["spans"]["records"] == 7)
         junk_path = os.path.join(tmp, "report_junk.json")
         atomic_write_bytes(junk_path, json.dumps(
             {"schema": "obs-report-v9"}).encode())

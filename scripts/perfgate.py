@@ -20,7 +20,9 @@ and CPU-only; pure file work, no backend):
 * `artifacts/r*/BENCH_*_local.json`         — committed on-chip/CPU
   bench lines (last line per file),
 * `artifacts/r*/serving/serve_bench*.json`  — serve-bench-v1 curves
-  (fault-injected artifacts gate separately: `+faults` key suffix),
+  (fault-injected artifacts gate separately: `+faults` key suffix) and
+  serve-bench-fleet-v1 fleet rows (ISSUE 12: per-N goodput/p99 plus the
+  per-replica scaling efficiency in the tight `eff` class),
 * `artifacts/r*/roofline/*.json`            — roofline-v1 per-op-class
   HBM bytes (diff artifacts skipped),
 * `artifacts/r*/scaling*.json`              — scaling-v2 strong/weak
@@ -242,6 +244,39 @@ def obs_from_serve_artifact(d: Dict, rnd: int, source: str) -> List[Obs]:
     return out
 
 
+def obs_from_fleet_artifact(d: Dict, rnd: int, source: str) -> List[Obs]:
+    """serve-bench-fleet-v1 rows (ISSUE 12): per-N fleet goodput/p99
+    (rate/time — wide on CPU) and the per-replica scaling efficiency
+    goodput@N / (N * goodput@1), which gates in the tight `eff` class
+    exactly like scaling.py's sharding efficiency: a ratio of two runs
+    on the same box at the same time, so box noise mostly cancels — a
+    -20% fleet-scaling regression must FAIL even on CPU."""
+    if d.get("schema") != "serve-bench-fleet-v1":
+        return []
+    platform = d.get("platform") or "?"
+    sig = "%s,%s,%s,sim%g" % (platform, d.get("imsize", "?"),
+                              d.get("infer_dtype", "?"),
+                              d.get("replica_sim_ms", 0))
+    out = []
+    for row in d.get("rows") or []:
+        n = row.get("replicas")
+        if n is None:
+            continue
+        if isinstance(row.get("goodput_rps"), (int, float)):
+            out.append(Obs("fleet[%s].goodput@n%s" % (sig, n),
+                           row["goodput_rps"], HIGHER, "rate", platform,
+                           rnd, source))
+        if isinstance(row.get("p99_ms"), (int, float)):
+            out.append(Obs("fleet[%s].p99_ms@n%s" % (sig, n),
+                           row["p99_ms"], LOWER, "time", platform, rnd,
+                           source))
+        if isinstance(row.get("scaling_eff"), (int, float)):
+            out.append(Obs("fleet[%s].scaling_eff@n%s" % (sig, n),
+                           row["scaling_eff"], HIGHER, "eff", platform,
+                           rnd, source))
+    return out
+
+
 def obs_from_roofline(d: Dict, rnd: int, source: str) -> List[Obs]:
     if d.get("schema") != "roofline-v1":
         return []  # roofline-diff-v1 etc. are derived artifacts
@@ -358,6 +393,7 @@ def scan_observations(root: str) -> List[Obs]:
         except (OSError, json.JSONDecodeError):
             continue
         out += obs_from_serve_artifact(d, _round_of(path), rel(path))
+        out += obs_from_fleet_artifact(d, _round_of(path), rel(path))
     for path in sorted(glob.glob(os.path.join(
             root, "artifacts", "*", "roofline", "*.json"))):
         try:
@@ -501,6 +537,8 @@ def candidate_observations(path: str) -> List[Obs]:
             raise SystemExit("--candidate: unreadable artifact %s" % path)
     if d.get("schema") == "serve-bench-v1":
         return obs_from_serve_artifact(d, rnd, path)
+    if d.get("schema") == "serve-bench-fleet-v1":
+        return obs_from_fleet_artifact(d, rnd, path)
     if d.get("schema") == "roofline-v1":
         return obs_from_roofline(d, rnd, path)
     if d.get("schema") == "scaling-v2":
@@ -643,6 +681,26 @@ def _fixture_tree(tmp: str) -> None:
     # regression must FAIL against
     jline(os.path.join(tmp, "artifacts", "r02", "scaling.json"),
           _scaling_fixture(0.90, 41.0))
+    # serve-bench-fleet-v1 rows (ISSUE 12): the fleet-scaling acceptance
+    # fixture a -20% candidate regression must FAIL against
+    jline(os.path.join(tmp, "artifacts", "r02", "serving",
+                       "serve_bench_fleet.json"),
+          _fleet_fixture(0.97, 776.0))
+
+
+def _fleet_fixture(eff4: float, goodput4: float) -> Dict:
+    return {"schema": "serve-bench-fleet-v1", "platform": "cpu",
+            "imsize": 64, "infer_dtype": "bf16", "replica_sim_ms": 40.0,
+            "fleet_load": 2.0, "replicas": [1, 4],
+            "rows": [
+                {"replicas": 1, "goodput_rps": 200.0, "p99_ms": 210.0,
+                 "per_replica_goodput": 200.0, "scaling_eff": 1.0,
+                 "lost": 0},
+                {"replicas": 4, "goodput_rps": goodput4, "p99_ms": 250.0,
+                 "per_replica_goodput": round(goodput4 / 4, 2),
+                 "scaling_eff": eff4, "lost": 0}],
+            "canary": {"outcome": "rolled-back", "lost_acks": 0},
+            "gate_scaling_08": True, "gate_zero_lost_acks": True}
 
 
 def _scaling_fixture(eff8: float, img_chip8: float) -> Dict:
@@ -769,6 +827,23 @@ def selfcheck() -> int:
         check("efficiency wiggle + cpu throughput dip pass",
               run(["--root", tmp, "--ledger", ledger,
                    "--candidate", ok_eff]) == 0)
+        # the ISSUE 12 acceptance fixture: a -20% fleet-scaling
+        # regression must FAIL even on CPU — scaling_eff is a same-box
+        # ratio in the tight `eff` class, like sharding efficiency
+        check("fleet scaling efficiency tracked in the ledger",
+              "fleet[cpu,64,bf16,sim40].scaling_eff@n4"
+              in load_ledger(ledger)["entries"])
+        bad_fleet = os.path.join(tmp, "cand_fleet.json")
+        save_json(bad_fleet,
+                  _fleet_fixture(round(0.97 * 0.8, 4), 776.0 * 0.8))
+        check("-20% fleet scaling FAILS the gate",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", bad_fleet]) == 1)
+        ok_fleet = os.path.join(tmp, "cand_fleet_ok.json")
+        save_json(ok_fleet, _fleet_fixture(0.93, 700.0))
+        check("fleet efficiency wiggle + cpu goodput dip pass",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", ok_fleet]) == 0)
         # within-tolerance chip wiggle and a 30%-slow CPU line both pass
         okc = os.path.join(tmp, "cand_ok.json")
         save_json(okc, {"platform": "tpu", "imsize": 512, "batch": 16,

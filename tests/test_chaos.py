@@ -196,6 +196,71 @@ def test_serving_chaos_with_deadlines_accounts_every_request(serve_parts):
 
 
 # ---------------------------------------------------------------------------
+# fleet sites (ISSUE 12): router-level faults heal by re-dispatch/respawn
+
+
+def test_fleet_sites_registered_and_seedable():
+    """The classification links for the two new sites, pinned directly:
+    fleet:replica only draws worker-death (the caller — FleetRouter —
+    kills and respawns the replica), fleet:dispatch draws the transient
+    routing faults, both are in ALL_SITES, and seeded schedules can draw
+    them replayably."""
+    from real_time_helmet_detection_tpu.runtime.faults import (ALL_SITES,
+                                                               FLEET_SITES,
+                                                               SITE_KINDS)
+    assert FLEET_SITES == ("fleet:dispatch", "fleet:replica")
+    assert set(FLEET_SITES) <= set(ALL_SITES)
+    assert SITE_KINDS["fleet:replica"] == ("worker-death",)
+    assert set(SITE_KINDS["fleet:dispatch"]) == {"device-loss",
+                                                 "slow-batch"}
+    a = FaultSchedule.seeded(7, n=4, sites=FLEET_SITES)
+    assert a.spec() == FaultSchedule.seeded(7, n=4,
+                                            sites=FLEET_SITES).spec()
+    assert all(e.site in FLEET_SITES for e in a)
+
+
+def test_fleet_replica_death_acceptance(serve_parts):
+    """THE fleet acceptance row: an injected fleet:replica worker-death
+    plus a fleet:dispatch device-loss against a live 2-replica router
+    loses ZERO acknowledged requests — the killed replica's queued acks
+    re-dispatch to the survivor, a fresh replica respawns into the slot,
+    and every survivor is bit-identical to one-shot predict."""
+    import time
+
+    from real_time_helmet_detection_tpu.obs.metrics import MetricsRegistry
+    from real_time_helmet_detection_tpu.serving import FleetRouter
+
+    predict, variables, pool, oracle = serve_parts
+
+    def factory(rid, start=True):
+        return ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3),
+                             np.uint8, buckets=(1, 2), max_wait_ms=1.0,
+                             depth=2, queue_capacity=64, max_retries=4,
+                             metrics=MetricsRegistry(), start=start)
+
+    inj = ChaosInjector(FaultSchedule([
+        FaultEvent("fleet:dispatch", "device-loss", 3),
+        FaultEvent("fleet:replica", "worker-death", 6),
+    ]))
+    router = FleetRouter(factory, 2, metrics=MetricsRegistry(),
+                         injector=inj)
+    futs = []
+    for k in range(20):
+        i = k % len(pool)
+        futs.append((i, router.submit(pool[i])))
+        if k % 3 == 0:
+            time.sleep(0.002)
+    rows = [(i, f.result(timeout=120)) for i, f in futs]
+    st = router.stats()
+    router.close()
+    assert len(inj.fired) == 2 and inj.pending() == 0
+    assert st["lost"] == 0, "acknowledged requests were lost"
+    assert st["replica_deaths"] == 1 and st["respawns"] == 1
+    assert st["dispatch_faults"] == 1
+    assert all(_rows_equal(r, oracle[i]) for i, r in rows)
+
+
+# ---------------------------------------------------------------------------
 # training: injected NaN -> sentinel rollback == clean resume
 
 

@@ -120,6 +120,19 @@ AST_CASES = [
      "    compiled = barrier_synced_compile(step, (state, *arrays),\n"
      "                                      name='train_step')\n"
      "    return compiled(state, *arrays)\n"),
+    ("ast/engine-bypass-in-fleet",
+     "real_time_helmet_detection_tpu/serving/fleet_x.py",
+     # raw engine construction + direct replica-engine submit in fleet
+     # code: traffic escapes tenant/SLO/canary accounting (ISSUE 12)
+     "def route(predict, variables, replicas, image):\n"
+     "    spare = ServingEngine(predict, variables, (64, 64, 3),\n"
+     "                          'uint8')\n"
+     "    return replicas[0].engine.submit(image)\n",
+     # router dispatch + factory construction — the sanctioned shape
+     "def route(router, image):\n"
+     "    return router.submit(image, tenant='bulk')\n"
+     "def spawn(factory, rid):\n"
+     "    return factory(rid, True)\n"),
     ("ast/unbounded-retry", "scripts/x.py",
      # the r2 probe-kill class: swallow + loop forever, no cap, no pause
      "import jax\n"
@@ -146,6 +159,28 @@ AST_CASES = [
 def test_ast_rule_fires_and_stays_silent(rule, path, bad, good):
     assert rule in rules_of(ast_rules.lint_source(bad, path))
     assert rule not in rules_of(ast_rules.lint_source(good, path))
+
+
+def test_engine_bypass_in_fleet_scope_and_allowlist():
+    """The rule follows fleet code, not paths alone: the same bad source
+    is silent in a plain script, fires once the module references
+    FleetRouter (import or name), and the sanctioned dispatch scope is
+    allowlisted by qualname."""
+    bad = ("def route(predict, variables, replicas, image):\n"
+           "    eng = ServingEngine(predict, variables, (64, 64, 3),\n"
+           "                        'uint8')\n"
+           "    return replicas[0].engine.submit(image)\n")
+    rule = "ast/engine-bypass-in-fleet"
+    assert rule not in rules_of(
+        ast_rules.lint_source(bad, "scripts/plain.py"))
+    assert rule in rules_of(ast_rules.lint_source(
+        "from real_time_helmet_detection_tpu.serving import FleetRouter\n"
+        + bad, "scripts/plain.py"))
+    # the shipped sanctioned scopes really are in the allowlist
+    assert ("real_time_helmet_detection_tpu/serving/fleet.py::"
+            "FleetRouter._dispatch") in ast_rules.FLEET_ENGINE_ALLOW
+    assert "scripts/serve_bench.py::make_replica_factory" \
+        in ast_rules.FLEET_ENGINE_ALLOW
 
 
 def test_queue_bypass_scoped_to_chip_scripts():

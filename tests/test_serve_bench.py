@@ -100,6 +100,62 @@ def test_selfcheck_subprocess():
     assert rec["tool"] == "serve_bench" and not rec["failures"]
 
 
+def test_committed_fleet_artifact_meets_the_gates():
+    """The ISSUE 12 acceptance artifact (serve-bench-fleet-v1): N in
+    {1,2,4} rows with per-replica scaling efficiency >= 0.8 at 2x
+    offered load, a canary run that ROLLED BACK on a canary-slice alert,
+    and zero lost acknowledged requests everywhere. The ONE-JSON-line
+    field contract (`replicas`/`tenants`/`canary`) is pinned here too —
+    the artifact IS the line's payload."""
+    path = os.path.join(REPO, "artifacts", "r14", "serving",
+                        "serve_bench_fleet.json")
+    if not os.path.exists(path):
+        pytest.skip("r14 fleet artifact not generated yet")
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["schema"] == "serve-bench-fleet-v1"
+    assert rec["replicas"] == [1, 2, 4]
+    assert isinstance(rec["tenants"], list) and rec["tenants"]
+    assert [r["replicas"] for r in rec["rows"]] == [1, 2, 4]
+    for row in rec["rows"]:
+        assert row["scaling_eff"] >= 0.8
+        assert row["lost"] == 0
+        assert row["p50_ms"] <= row["p99_ms"]
+    assert rec["canary"]["outcome"] == "rolled-back"
+    assert "canary-error-burn" in rec["canary"]["alerts"]
+    assert rec["canary"]["lost_acks"] == 0
+    assert rec["death"]["lost_acks"] == 0
+    assert rec["death"]["respawns"] == rec["death"]["replica_deaths"] == 1
+    assert rec["gate_scaling_08"] is True
+    assert rec["gate_zero_lost_acks"] is True
+
+
+def test_fleet_artifact_parses_through_perfgate_candidate():
+    """find_last_tpu_result-style parsing regression: the fleet artifact
+    is sniffed by schema and keyed for the ledger (goodput/p99 per N,
+    scaling_eff in the tight eff class) — the parse path perfgate's
+    --candidate and repo scan share."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "perfgate", os.path.join(REPO, "scripts", "perfgate.py"))
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+    path = os.path.join(REPO, "artifacts", "r14", "serving",
+                        "serve_bench_fleet.json")
+    if not os.path.exists(path):
+        pytest.skip("r14 fleet artifact not generated yet")
+    obs = pg.candidate_observations(path)
+    keys = {o.key for o in obs}
+    assert any(k.endswith(".scaling_eff@n4") for k in keys)
+    assert any(k.endswith(".goodput@n2") for k in keys)
+    eff = [o for o in obs if o.key.endswith(".scaling_eff@n4")]
+    assert eff and eff[0].klass == "eff" and eff[0].value >= 0.8
+    # the serve-bench-v1 extractor must NOT swallow the fleet schema
+    with open(path) as f:
+        d = json.load(f)
+    assert pg.obs_from_serve_artifact(d, 14, path) == []
+
+
 def test_committed_cpu_artifact_meets_the_gate():
     """The acceptance artifact (artifacts/r10/serving/serve_bench.json,
     schema serve-bench-v1) must exist, carry the offered-load curve, and
