@@ -79,11 +79,27 @@ DEFAULT_HBM = 819e9  # v5e
 # and runner_drive.py (they diverged in r5: mfu_breakdown defaulted to r05
 # while the rest stayed at r04, scattering same-round artifacts — ADVICE
 # r5 #3); bump it here when a new round starts, or override per-run with
-# $GRAFT_ROUND. r14 = the serving-fleet round (ISSUE 12: FleetRouter over
-# N ServingEngine replicas — per-tenant SLOs, canary rollout, replica
-# self-healing, the serve_bench --replicas fleet curves); earlier rounds'
+# $GRAFT_ROUND. r15 = the latency-tier round (ISSUE 13: Lighter-Hourglass
+# variants, arch_grid search, distillation, the per-tier Pareto frontier
+# in quality_matrix + the perfgate `quality` class); earlier rounds'
 # artifact dirs are committed history and must not be overwritten.
-GRAFT_ROUND_DEFAULT = "r14"
+GRAFT_ROUND_DEFAULT = "r15"
+
+# The arch fields every bench line carries (ISSUE 13): the residual-block
+# variant, stack count, width and the resolved tier name. Pre-tier lines
+# lack them — `bench_arch_of` parses ANY bench line (old or new) into the
+# full dict, defaulting absent fields to the historical bench config
+# (residual, 1 stack, width 128 = the "flagship" tier name), so every
+# committed BENCH_r* trajectory keeps reading as the same program.
+ARCH_DEFAULTS = {"variant": "residual", "num_stack": 1, "width": 128,
+                 "tier": "flagship"}
+
+
+def bench_arch_of(rec: dict) -> dict:
+    """The (variant, num_stack, width, tier) of a bench JSON line;
+    pre-tier lines parse as the flagship defaults (regression-tested —
+    the ONE-line contract and every committed trajectory keep reading)."""
+    return {k: rec.get(k, v) for k, v in ARCH_DEFAULTS.items()}
 
 # v5e int8 MXU peak (2x the bf16 peak — jax-ml scaling-book): the
 # denominator for int8-path MFU and the hardware case for --infer-dtype
@@ -242,7 +258,10 @@ def find_last_tpu_result(repo_root: str | None = None) -> dict | None:
             "int8_vs_bf16", "recompile_count", "loadavg", "param_policy",
             "epilogue", "serve_p50_ms", "serve_p99_ms", "serve_goodput",
             "sentinel", "skipped_steps", "step_p50_ms", "step_p99_ms",
-            "device_count", "mesh_shape")
+            "device_count", "mesh_shape",
+            # arch fields (ISSUE 13): absent on pre-tier lines — the
+            # consumer parses via bench_arch_of (flagship defaults)
+            "variant", "num_stack", "width", "tier")
     out.update({k: rec[k] for k in keep if k in rec})
     return out
 
@@ -465,8 +484,27 @@ def _bench(out: dict, hb) -> None:
     if infer_dtype not in ("bf16", "int8"):
         raise SystemExit("--infer-dtype must be bf16 or int8, got %r"
                          % infer_dtype)
-    cfg = Config(num_stack=1, hourglass_inch=128, num_cls=2, topk=100,
-                 conf_th=0.0, nms_th=0.5, imsize=imsize)
+    # --tier <name> / BENCH_TIER (ISSUE 13): bench the named tier's
+    # ARCHITECTURE (variant/stacks/width from config.TIER_PRESETS) instead
+    # of the historical flagship config; the arch fields ride the ONE JSON
+    # line either way, so every line says which program it measured.
+    tier = os.environ.get("BENCH_TIER", "")
+    if "--tier" in sys.argv:
+        i = sys.argv.index("--tier")
+        if i + 1 >= len(sys.argv):
+            raise SystemExit("--tier needs a value (edge|throughput|"
+                             "quality)")
+        tier = sys.argv[i + 1]
+    from real_time_helmet_detection_tpu.config import TIER_PRESETS
+    arch = {"variant": "residual", "num_stack": 1, "hourglass_inch": 128,
+            "stem_width": 0}
+    if tier:
+        if tier not in TIER_PRESETS:
+            raise SystemExit("--tier must be one of %s, got %r"
+                             % (sorted(TIER_PRESETS), tier))
+        arch = {k: TIER_PRESETS[tier].get(k, arch[k]) for k in arch}
+    cfg = Config(num_cls=2, topk=100,
+                 conf_th=0.0, nms_th=0.5, imsize=imsize, **arch)
     model = build_model(cfg, dtype=dtype)
     rng = np.random.default_rng(0)
     out.update({
@@ -476,6 +514,8 @@ def _bench(out: dict, hb) -> None:
         "dtype": "float32" if dtype is None else "bfloat16",
         "infer_dtype": infer_dtype,
         "imsize": imsize, "batch": batch,
+        "variant": cfg.variant, "num_stack": cfg.num_stack,
+        "width": cfg.hourglass_inch, "tier": tier or "flagship",
     })
 
     if not on_tpu:
@@ -654,9 +694,9 @@ def _bench(out: dict, hb) -> None:
         # program, and the line says so (sentinel: "off").
         sentinel_on = (os.environ.get("BENCH_SENTINEL") == "1"
                        or "--sentinel" in sys.argv)
-        tcfg = Config(num_stack=1, hourglass_inch=128, num_cls=2,
+        tcfg = Config(num_cls=2,
                       batch_size=train_batch, amp=dtype is not None,
-                      imsize=imsize,
+                      imsize=imsize, **arch,
                       remat=os.environ.get("BENCH_REMAT", "none"),
                       loss_kernel=os.environ.get("BENCH_LOSS_KERNEL",
                                                  "auto"),

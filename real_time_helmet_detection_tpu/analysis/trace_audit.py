@@ -238,8 +238,27 @@ STEP_GRID_REMAT = ("none", "stacks", "full")
 _TINY = dict(num_stack=1, hourglass_inch=16, num_cls=2, imsize=64)
 _BATCH = 2
 
+# The tier variants audited end to end (ISSUE 13): the SMALLEST tier
+# architecture (edge: depthwise blocks, 1 stack, narrow) and the LARGEST
+# (quality: residual blocks, 2 stacks) — tiny-width twins of
+# config.TIER_PRESETS' shapes. Each gets a train-step + predict entry so
+# the whole tier family obeys the dynamic-shape/f64/donation/retrace
+# rules, not just the flagship graph.
+TIER_AUDIT = (
+    ("edge", dict(variant="ghost", num_stack=1, hourglass_inch=8,
+                  stem_width=8)),
+    # depthwise ships as a first-class variant even though no current
+    # preset selects it (the chip arch_grid may) — its trace surface is
+    # audited like the presets' (no lowering: jaxpr rules only)
+    ("depthwise-variant", dict(variant="depthwise", num_stack=1,
+                               hourglass_inch=8, stem_width=8)),
+    ("quality", dict(variant="residual", num_stack=2,
+                     hourglass_inch=16, stem_width=16)),
+)
 
-def _tiny_train_parts(remat: str = "none", param_policy: str = "fp32"):
+
+def _tiny_train_parts(remat: str = "none", param_policy: str = "fp32",
+                      arch: Optional[dict] = None):
     import jax
     import jax.numpy as jnp
 
@@ -251,9 +270,10 @@ def _tiny_train_parts(remat: str = "none", param_policy: str = "fp32"):
                          make_train_step_body)
 
     # bf16-compute requires the bf16 compute policy (config.py validates)
+    tiny = dict(_TINY, **(arch or {}))
     cfg = Config(batch_size=_BATCH, remat=remat, loss_kernel="xla",
                  amp=param_policy == "bf16-compute",
-                 param_policy=param_policy, **_TINY)
+                 param_policy=param_policy, **tiny)
     model = build_model(cfg, dtype=jnp.bfloat16 if cfg.amp else None)
     tx = build_optimizer(cfg, 10)
     state = create_train_state(model, cfg, jax.random.key(0),
@@ -266,7 +286,8 @@ def _tiny_train_parts(remat: str = "none", param_policy: str = "fp32"):
 
 
 def _tiny_predict_parts(normalize: Optional[str] = None,
-                        epilogue: str = "auto"):
+                        epilogue: str = "auto",
+                        arch: Optional[dict] = None):
     import jax
     import numpy as np
 
@@ -276,7 +297,7 @@ def _tiny_predict_parts(normalize: Optional[str] = None,
     from ..train import init_variables
 
     cfg = Config(topk=16, conf_th=0.0, nms_th=0.5, epilogue=epilogue,
-                 **_TINY)
+                 **dict(_TINY, **(arch or {})))
     model = build_model(cfg)
     params, batch_stats = init_variables(model, jax.random.key(0),
                                          _TINY["imsize"])
@@ -426,6 +447,40 @@ def audit_repo_entry_points(lower: bool = True) -> List[Finding]:
             message="entry construction failed: %s: %s"
                     % (type(e).__name__,
                        (str(e).splitlines() or ["?"])[0][:200])))
+
+    for tier, arch in TIER_AUDIT:
+        # the tier family (ISSUE 13): smallest + largest tier variants,
+        # train step AND predict — a depthwise/ghost block that traced
+        # dynamically, leaked f64 or broke the scan's donation contract
+        # would ship in every tier checkpoint
+        entry = "train_step_scanned[tier=%s]" % tier
+        try:
+            train_n, targs = _tiny_train_parts("none", arch=arch)
+            findings += audit_entry(train_n, targs, entry,
+                                    donate_argnums=(0,),
+                                    lower=lower and tier == "edge")
+        except Exception as e:  # noqa: BLE001
+            findings.append(Finding(
+                rule="trace/trace-failure", path="<%s>" % entry,
+                context=entry,
+                message="entry construction failed: %s: %s"
+                        % (type(e).__name__,
+                           (str(e).splitlines() or ["?"])[0][:200])))
+        entry = "predict[tier=%s]" % tier
+        try:
+            predict_t, variables_t, images_t = _tiny_predict_parts(
+                arch=arch)
+            findings += audit_entry(
+                lambda v, im, _p=predict_t: _p(v, im),
+                (variables_t, images_t), entry,
+                lower=lower and tier == "edge")
+        except Exception as e:  # noqa: BLE001
+            findings.append(Finding(
+                rule="trace/trace-failure", path="<%s>" % entry,
+                context=entry,
+                message="entry construction failed: %s: %s"
+                        % (type(e).__name__,
+                           (str(e).splitlines() or ["?"])[0][:200])))
 
     try:
         predict, variables, images = _tiny_predict_parts()

@@ -38,12 +38,50 @@ from typing import List, Optional
 import numpy as np
 
 # The architecture fields restored from a checkpoint's snapshot at eval time
-# (ref config.py:171-179's `targets` list).
+# (ref config.py:171-179's `targets` list). `variant` (ISSUE 13) is an
+# architecture field like num_stack: evaluating a depthwise checkpoint
+# with the residual graph would fail the restore (different param tree).
 ARCHITECTURE_FIELDS = (
     "scale_factor", "num_cls", "pretrained", "normalized_coord",
     "num_stack", "hourglass_inch", "increase_ch", "activation", "pool",
-    "neck_activation", "neck_pool",
+    "neck_activation", "neck_pool", "variant", "stem_width",
 )
+
+# Residual-block variants (ISSUE 13, per Lighter Stacked Hourglass arxiv
+# 2107.13643): "residual" = the reference's two-3x3-conv block,
+# "depthwise" = depthwise-separable convs (kxk depthwise + 1x1 pointwise),
+# "ghost" = ghost modules (1x1 primary half + cheap depthwise half).
+# models/hourglass.py consumes this vocabulary; defined here (stdlib-only
+# module) so config validation never imports the model stack.
+MODEL_VARIANTS = ("residual", "depthwise", "ghost")
+
+# Latency-tier presets (ISSUE 13): named architecture+serving bundles —
+# the product tiers the fleet router mixes per tenant. `--tier edge`
+# overrides the listed Config fields (tier wins over individual arch
+# flags, exactly as --preset sweep-best wins over step flags); everything
+# else stays at CLI/default values. Widths/stacks/variants come from the
+# r15 arch_grid counting-model sweep (artifacts/r15/sweep.json) with the
+# quality tier pinned to the flagship stack2+soft-NMS recipe (0.7734
+# held-out mAP, r05). serve_buckets per tier = each tier's own AOT bucket
+# set (engine/export/C++ runner all read cfg.serve_buckets).
+TIER_PRESETS = {
+    # b1-latency-first: the arch_grid counting model's FLOPs AND bytes
+    # floor (ghost-w64: 0.049 GF / 10.9 MB vs depthwise-w64's 0.072 GF /
+    # 14.5 MB at 64^2 — artifacts/r15/sweep.cpu.json arch_grid_selected);
+    # small buckets, never wait. Chip arch_grid --arch-map (queued)
+    # re-decides with real mAP columns.
+    "edge": dict(variant="ghost", num_stack=1, hourglass_inch=64,
+                 stem_width=64, increase_ch=0, serve_buckets=[1, 2, 4],
+                 serve_max_wait_ms=0.0),
+    # batch-16 goodput + int8 PTQ (PR 5) — the bulk-traffic tier
+    "throughput": dict(variant="ghost", num_stack=1, hourglass_inch=96,
+                       stem_width=96, increase_ch=0, infer_dtype="int8",
+                       serve_buckets=[4, 8, 16]),
+    # the flagship recipe: stack2 + soft-NMS (quality_matrix r05 winner)
+    "quality": dict(variant="residual", num_stack=2, hourglass_inch=128,
+                    increase_ch=0, nms="soft-nms",
+                    serve_buckets=[1, 2, 4, 8, 16]),
+}
 
 
 @dataclass
@@ -189,7 +227,32 @@ class Config:
     focal_alpha: float = 2.0
     focal_beta: float = 4.0
 
+    # distillation (ISSUE 13): teacher-student training for the small
+    # tiers. --distill names a teacher checkpoint (dir or save dir); the
+    # teacher runs INSIDE the jitted step under stop_gradient (fixed
+    # shapes, composes with --grad-accum/--sentinel/bf16-compute) and its
+    # last stack's heatmap/offset/size soft targets mix into the loss at
+    # weight --distill-alpha. The soft-loss scalars ride the SAME
+    # deferred loss fetch as every other loss component (zero extra D2H,
+    # the --telemetry contract). Teacher architecture comes from the
+    # checkpoint dir's argument.json snapshot, so a flagship teacher can
+    # distill into any tier's student.
+    distill: Optional[str] = None
+    distill_alpha: float = 0.5
+
     # network
+    tier: str = ""                # "" | edge | throughput | quality: named
+    # latency-tier preset (ISSUE 13) — overrides the TIER_PRESETS fields
+    # (variant/stacks/width/serving); see apply_tier
+    variant: str = "residual"     # residual-block variant (MODEL_VARIANTS;
+    # Lighter-Hourglass depthwise/ghost blocks, ISSUE 13). Checkpoint
+    # param trees differ per variant — eval restores it from the snapshot
+    # like num_stack.
+    stem_width: int = 0           # PreLayer mid width; 0 = the reference's
+    # fixed 128 (every pre-tier checkpoint keeps its exact graph). Tier
+    # presets set it to the model width so narrow tiers don't carry a
+    # flagship-width stem at full resolution. Architecture field (snapshot
+    # restores it).
     scale_factor: int = 4        # structurally 4: PreLayer's stem downsample
     # is 2x conv + 2x pool (ref hourglass.py:163-165); unlike the reference
     # (which reads it in decode only and would silently mis-decode,
@@ -387,6 +450,18 @@ class Config:
         if self.preset not in ("", "sweep-best"):
             raise ValueError("--preset must be '' or 'sweep-best', got %r"
                              % (self.preset,))
+        if self.variant not in MODEL_VARIANTS:
+            raise ValueError("--variant must be one of %s, got %r"
+                             % (MODEL_VARIANTS, self.variant))
+        if self.tier and self.tier not in TIER_PRESETS:
+            raise ValueError("--tier must be '' or one of %s, got %r"
+                             % (sorted(TIER_PRESETS), self.tier))
+        if not self.distill_alpha > 0:
+            raise ValueError("--distill-alpha must be > 0, got %r"
+                             % (self.distill_alpha,))
+        if self.stem_width < 0:
+            raise ValueError("--stem-width must be >= 0 (0 = the "
+                             "reference's 128), got %d" % self.stem_width)
         if self.infer_dtype not in ("bf16", "int8"):
             raise ValueError("--infer-dtype must be 'bf16' or 'int8', "
                              "got %r" % (self.infer_dtype,))
@@ -536,6 +611,38 @@ def apply_preset(cfg: Config) -> Config:
     return dataclasses.replace(cfg, **over)
 
 
+def apply_tier(cfg: Config) -> Config:
+    """Resolve `--tier` into concrete Config fields (no-op when unset).
+
+    The tier WINS over individually-passed architecture/serving flags —
+    it is the "give me the edge product" button, the exact semantics
+    --preset sweep-best has for the step-compression flags. Composes with
+    --preset (tier sets the architecture, the sweep pick sets the train
+    step)."""
+    if not cfg.tier:
+        return cfg
+    over = TIER_PRESETS[cfg.tier]
+    print("--tier %s: %s" % (cfg.tier, over), flush=True)
+    return dataclasses.replace(cfg, **over)
+
+
+def tier_of(cfg) -> str:
+    """The tier name whose ARCHITECTURE fields (variant/stacks/width)
+    match `cfg`, else "flagship" for the historical bench default
+    (residual, 1 stack, width 128 — every pre-tier bench line parses as
+    this) or "custom". Used by bench.py's arch fields; serving knobs
+    deliberately don't participate (a bench overrides buckets freely)."""
+    arch = (getattr(cfg, "variant", "residual"), cfg.num_stack,
+            cfg.hourglass_inch)
+    for name, over in TIER_PRESETS.items():
+        if arch == (over["variant"], over["num_stack"],
+                    over["hourglass_inch"]):
+            return name
+    if arch == ("residual", 1, 128):
+        return "flagship"
+    return "custom"
+
+
 def seed_everything(seed: int) -> None:
     """Global seeding (ref config.py:143-147). JAX RNG is explicit
     (jax.random.key), threaded through the train/data code; host-side
@@ -576,6 +683,7 @@ def get_config(argv=None) -> Config:
     """Full CLI entry (ref config.py:139-169): parse, seed, snapshot dirs,
     eval-time architecture restore."""
     cfg = parse_args(argv)
+    cfg = apply_tier(cfg)
     cfg = apply_preset(cfg)
     seed_everything(cfg.random_seed)
 

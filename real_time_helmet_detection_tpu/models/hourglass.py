@@ -46,6 +46,16 @@ QUANT_MODES = ("off", "calibrate", "int8")
 # (ops/pallas/epilogue.py) where eligible.
 EPILOGUE_MODES = ("xla", "fused")
 
+# residual-block variants (ISSUE 13; Lighter Stacked Hourglass, arxiv
+# 2107.13643): the `variant` axis of the latency-tier model family. ONE
+# vocabulary shared with config.py (MODEL_VARIANTS there — stdlib-only;
+# tests pin the two tuples equal). Every variant is built from the SAME
+# `Convolution` block, so BN folding (ops/quant.fold_batchnorm), int8 PTQ
+# (QuantConv) and the fused BN+activation epilogue (FusedBNAct) apply to
+# every tier for free — the BN tree keeps the Conv_0+BatchNorm_0 sibling
+# shape throughout.
+VARIANTS = ("residual", "depthwise", "ghost")
+
 
 def resolve_epilogue(cfg) -> str:
     """'fused' | 'xla' for this backend: --epilogue auto selects the
@@ -214,6 +224,7 @@ class QuantConv(nn.Module):
     kernel_size: int = 3
     stride: int = 1
     padding: int = 1
+    groups: int = 1     # feature_group_count (depthwise/ghost variants)
     mode: str = "int8"  # "calibrate" | "int8"
     calib_percentile: float = 100.0
     dtype: Optional[Dtype] = None
@@ -222,7 +233,8 @@ class QuantConv(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         k = self.kernel_size
         kernel = self.param("kernel", nn.initializers.lecun_normal(),
-                            (k, k, x.shape[-1], self.features))
+                            (k, k, x.shape[-1] // self.groups,
+                             self.features))
         bias = self.param("bias", nn.initializers.zeros_init(),
                           (self.features,))
         dt = self.dtype or x.dtype
@@ -237,7 +249,8 @@ class QuantConv(nn.Module):
             running.value = jnp.maximum(running.value, stat)
             y = jax.lax.conv_general_dilated(
                 x.astype(dt), kernel.astype(dt),
-                (self.stride, self.stride), pad, dimension_numbers=dn)
+                (self.stride, self.stride), pad, dimension_numbers=dn,
+                feature_group_count=self.groups)
         elif self.mode == "int8":
             # the calibrated clip range MUST be provided (the scales
             # pytree as the `quant` collection): a missing entry fails
@@ -249,7 +262,8 @@ class QuantConv(nn.Module):
             wq, w_scale = quantize_weights(kernel)
             acc = jax.lax.conv_general_dilated(
                 xq, wq, (self.stride, self.stride), pad,
-                dimension_numbers=dn, preferred_element_type=jnp.int32)
+                dimension_numbers=dn, preferred_element_type=jnp.int32,
+                feature_group_count=self.groups)
             y = acc.astype(dt) * (a_scale * w_scale).astype(dt)
         else:
             raise NotImplementedError("Not expected quant mode: %s"
@@ -343,6 +357,10 @@ class Convolution(nn.Module):
     use_bias: bool = True
     bn: bool = False
     activation: str = "ReLU"
+    groups: int = 1         # feature_group_count: 1 = dense (the
+    # reference's convs); out_ch = groups = input channels is a depthwise
+    # conv — the Lighter-Hourglass variants (ISSUE 13) are built from
+    # exactly this knob, so the BN/quant/epilogue machinery sees one block
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
     stem_s2d: bool = False  # use the space-to-depth stem formulation
@@ -369,13 +387,15 @@ class Convolution(nn.Module):
                          name="Conv_0")(x)
         elif quant_active:
             x = QuantConv(self.out_ch, kernel_size=k, stride=self.stride,
-                          padding=p, mode=self.quant_mode,
+                          padding=p, groups=self.groups,
+                          mode=self.quant_mode,
                           calib_percentile=self.calib_percentile,
                           dtype=self.dtype, name="Conv_0")(x)
         else:
             x = nn.Conv(self.out_ch, (k, k),
                         strides=(self.stride, self.stride),
                         padding=((p, p), (p, p)),
+                        feature_group_count=self.groups,
                         use_bias=self.use_bias or fold,
                         dtype=self.dtype)(x)
         if self.bn and not self.fold_bn:
@@ -393,9 +413,13 @@ class Convolution(nn.Module):
         return Activation(self.activation)(x)
 
 
-class Residual(nn.Module):
-    """Two 3x3 BN convs (second linear) + 1x1 BN skip on channel change,
-    post-add activation (ref hourglass.py:111-127)."""
+class GhostModule(nn.Module):
+    """Ghost module (Lighter Stacked Hourglass arxiv 2107.13643 §3 /
+    GhostNet): a 1x1 "primary" conv produces out_ch/2 intrinsic features,
+    a CHEAP depthwise kxk conv generates the other out_ch/2 "ghost"
+    features from them, concat — ~half the dense conv's FLOPs at the same
+    output width. Both halves are ordinary `Convolution` blocks (BN+act),
+    so fold/int8/epilogue machinery applies unchanged."""
     out_ch: int
     kernel_size: int = 3
     stride: int = 1
@@ -409,16 +433,85 @@ class Residual(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        if self.out_ch % 2:
+            raise ValueError(
+                "ghost variant needs an even channel width (half primary "
+                "+ half ghost features), got out_ch=%d" % self.out_ch)
+        half = self.out_ch // 2
         kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
                   fold_bn=self.fold_bn, quant_mode=self.quant_mode,
                   calib_percentile=self.calib_percentile,
                   epilogue=self.epilogue)
-        y = Convolution(self.out_ch, self.kernel_size, self.stride,
-                        use_bias=False, bn=True, activation=self.activation,
-                        **kw)(x, train)
-        y = Convolution(self.out_ch, self.kernel_size, self.stride,
-                        use_bias=False, bn=True, activation="Linear",
-                        **kw)(y, train)
+        primary = Convolution(half, 1, self.stride, use_bias=False,
+                              bn=True, activation=self.activation,
+                              **kw)(x, train)
+        ghost = Convolution(half, self.kernel_size, 1, use_bias=False,
+                            bn=True, activation=self.activation,
+                            groups=half, **kw)(primary, train)
+        return jnp.concatenate([primary, ghost], axis=-1)
+
+
+class Residual(nn.Module):
+    """Residual block, `variant`-selectable (ISSUE 13):
+
+    * "residual"  — two 3x3 BN convs (second linear) + 1x1 BN skip on
+      channel change, post-add activation (ref hourglass.py:111-127; the
+      flagship block, bit-identical to the pre-tier program);
+    * "depthwise" — each dense 3x3 becomes depthwise 3x3 + pointwise 1x1
+      (both BN'd; the Lighter-Hourglass separable block) — ~(1/C + 1/9)
+      of the dense conv's FLOPs;
+    * "ghost"     — each dense 3x3 becomes a `GhostModule`.
+
+    Skip path and post-add activation are identical across variants, so
+    the block's I/O contract (and the surrounding Hourglass geometry)
+    never changes."""
+    out_ch: int
+    kernel_size: int = 3
+    stride: int = 1
+    activation: str = "ReLU"
+    variant: str = "residual"
+    dtype: Optional[Dtype] = None
+    bn_axis_name: Optional[str] = None
+    fold_bn: bool = False
+    quant_mode: str = "off"
+    calib_percentile: float = 100.0
+    epilogue: str = "xla"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+                  fold_bn=self.fold_bn, quant_mode=self.quant_mode,
+                  calib_percentile=self.calib_percentile,
+                  epilogue=self.epilogue)
+        if self.variant == "depthwise":
+            in_ch = x.shape[-1]
+            y = Convolution(in_ch, self.kernel_size, self.stride,
+                            use_bias=False, bn=True,
+                            activation=self.activation, groups=in_ch,
+                            **kw)(x, train)
+            y = Convolution(self.out_ch, 1, 1, use_bias=False, bn=True,
+                            activation=self.activation, **kw)(y, train)
+            y = Convolution(self.out_ch, self.kernel_size, 1,
+                            use_bias=False, bn=True,
+                            activation=self.activation,
+                            groups=self.out_ch, **kw)(y, train)
+            y = Convolution(self.out_ch, 1, 1, use_bias=False, bn=True,
+                            activation="Linear", **kw)(y, train)
+        elif self.variant == "ghost":
+            y = GhostModule(self.out_ch, self.kernel_size, self.stride,
+                            activation=self.activation, **kw)(x, train)
+            y = GhostModule(self.out_ch, self.kernel_size, 1,
+                            activation="Linear", **kw)(y, train)
+        elif self.variant == "residual":
+            y = Convolution(self.out_ch, self.kernel_size, self.stride,
+                            use_bias=False, bn=True,
+                            activation=self.activation, **kw)(x, train)
+            y = Convolution(self.out_ch, self.kernel_size, self.stride,
+                            use_bias=False, bn=True, activation="Linear",
+                            **kw)(y, train)
+        else:
+            raise NotImplementedError("Not expected variant: %s"
+                                      % self.variant)
         if x.shape[-1] != self.out_ch:
             x = Convolution(self.out_ch, 1, self.stride, use_bias=False,
                             bn=True, activation="Linear", **kw)(x, train)
@@ -438,6 +531,7 @@ class Hourglass(nn.Module):
     increase_ch: int = 0
     activation: str = "ReLU"
     pool: str = "Max"
+    variant: str = "residual"
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
     fold_bn: bool = False
@@ -447,7 +541,8 @@ class Hourglass(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
-        kw = dict(activation=self.activation, dtype=self.dtype,
+        kw = dict(activation=self.activation, variant=self.variant,
+                  dtype=self.dtype,
                   bn_axis_name=self.bn_axis_name, fold_bn=self.fold_bn,
                   quant_mode=self.quant_mode,
                   calib_percentile=self.calib_percentile,
@@ -459,7 +554,8 @@ class Hourglass(nn.Module):
         low = Residual(mid_ch, **kw)(low, train)
         if self.num_layer > 1:
             low = Hourglass(self.num_layer - 1, mid_ch, self.increase_ch,
-                            self.activation, self.pool, self.dtype,
+                            self.activation, self.pool, self.variant,
+                            self.dtype,
                             self.bn_axis_name, self.fold_bn,
                             self.quant_mode, self.calib_percentile,
                             self.epilogue)(low, train)
@@ -483,6 +579,7 @@ class PreLayer(nn.Module):
     out_ch: int = 128
     activation: str = "ReLU"
     pool: str = "Max"
+    variant: str = "residual"
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
     stem_s2d: bool = False
@@ -498,16 +595,17 @@ class PreLayer(nn.Module):
                   calib_percentile=self.calib_percentile,
                   epilogue=self.epilogue)
         # the stem conv contracts over only 3 input channels and is the
-        # first layer: it stays in the float dtype (quantize=False) —
-        # folding its BN still applies
+        # first layer: it stays in the float dtype (quantize=False) and is
+        # NEVER a variant block (its 147-value contraction is already
+        # minimal) — folding its BN still applies
         x = Convolution(64, 7, 2, use_bias=True, bn=True,
                         activation=self.activation,
                         stem_s2d=self.stem_s2d, quantize=False,
                         **kw)(x, train)
-        x = Residual(self.mid_ch, **kw)(x, train)
+        x = Residual(self.mid_ch, variant=self.variant, **kw)(x, train)
         x = Pool(self.mid_ch, self.pool, dtype=self.dtype)(x)
-        x = Residual(self.mid_ch, **kw)(x, train)
-        x = Residual(self.out_ch, **kw)(x, train)
+        x = Residual(self.mid_ch, variant=self.variant, **kw)(x, train)
+        x = Residual(self.out_ch, variant=self.variant, **kw)(x, train)
         return x
 
 
@@ -517,6 +615,7 @@ class Neck(nn.Module):
     ch: int = 128
     activation: str = "ReLU"
     pool: str = "None"
+    variant: str = "residual"
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
     fold_bn: bool = False
@@ -533,7 +632,7 @@ class Neck(nn.Module):
         x = Pool(self.ch, self.pool, dtype=self.dtype)(x)
         x = Convolution(self.ch, 1, bn=True, activation=self.activation,
                         **kw)(x, train)
-        x = Residual(self.ch, **kw)(x, train)
+        x = Residual(self.ch, variant=self.variant, **kw)(x, train)
         return x
 
 
@@ -566,6 +665,13 @@ class StackedHourglass(nn.Module):
     pool: str = "Max"
     neck_activation: str = "ReLU"
     neck_pool: str = "None"
+    variant: str = "residual"  # residual-block variant (VARIANTS; the
+    # latency-tier axis, ISSUE 13) — every Residual in stem/hourglass/neck
+    # builds this block type; stem conv and heads are variant-invariant
+    stem_width: int = 0  # PreLayer mid width; 0 = the reference's fixed
+    # 128 (every pre-tier checkpoint). Tier presets set it to the model
+    # width: a 64-wide tier with a 128-wide stem would put most of its
+    # full-resolution bytes in the stem (ISSUE 13).
     dtype: Optional[Dtype] = None
     bn_axis_name: Optional[str] = None
     remat: Any = False  # "none"/False | "stacks"/True: rematerialize each
@@ -584,13 +690,15 @@ class StackedHourglass(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
-        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
+        kw = dict(variant=self.variant, dtype=self.dtype,
+                  bn_axis_name=self.bn_axis_name,
                   fold_bn=self.fold_bn, quant_mode=self.quant_mode,
                   calib_percentile=self.calib_percentile,
                   epilogue=self.epilogue)
         if self.dtype is not None:
             x = x.astype(self.dtype)
-        x = PreLayer(mid_ch=128, out_ch=self.in_ch, activation=self.activation,
+        x = PreLayer(mid_ch=self.stem_width or 128, out_ch=self.in_ch,
+                     activation=self.activation,
                      pool=self.pool, stem_s2d=self.stem_s2d, **kw)(x, train)
 
         # --remat stacks trades FLOPs for HBM: each stack's activations are
@@ -640,11 +748,17 @@ def build_model(args_or_cfg, dtype: Optional[Dtype] = None,
     if quant_mode != "off" and not fold_bn:
         raise ValueError("quant_mode=%r requires fold_bn=True (BN folds "
                          "before quantization)" % quant_mode)
+    variant = getattr(c, "variant", "residual")
+    if variant not in VARIANTS:
+        raise ValueError("variant must be one of %s, got %r"
+                         % (VARIANTS, variant))
     return StackedHourglass(
         num_stack=c.num_stack,
         in_ch=c.hourglass_inch,
         out_ch=c.num_cls + 4,
         increase_ch=c.increase_ch,
+        variant=variant,
+        stem_width=getattr(c, "stem_width", 0),
         activation=c.activation,
         pool=c.pool,
         neck_activation=c.neck_activation,

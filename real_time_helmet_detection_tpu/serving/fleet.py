@@ -67,6 +67,23 @@ Design rules, each load-bearing:
   the killed requests re-dispatch to surviving replicas. Respawned
   replicas are reloaded to the fleet's current stable weights, so a
   death mid-rollout cannot resurrect stale weights.
+* **Per-tenant tier policy (ISSUE 13).** Replica slots carry a TIER
+  label (`replica_tiers`; the factory owns the rid->tier mapping — an
+  edge-tier slot constructs an edge-tier engine, so a respawn into that
+  slot stays edge) and tenants carry a tier preference (`tenant_tiers`,
+  or per-submit `tier=`): bulk tenants route to the cheap tier, flagged
+  traffic to the quality tier — the ROADMAP interplay. Tier routing is
+  STRICT by default: different tiers run different networks, so silently
+  serving a bulk-tier answer to a quality tenant would be a wrong
+  result, not a degraded one — a tier with no routable replica sheds as
+  capacity (`tier_fallback=True` opts into any-tier fallback for
+  availability-over-fidelity deployments). Re-dispatch after a replica
+  death stays within the request's tier; per-tier results are
+  bit-identical to one-shot predict on that tier's model (pinned by
+  tests/test_tiers.py). Weight rollouts name their tier on
+  heterogeneous fleets (`rollout(..., tier=)`) — canary pick, promote
+  fan-out and the stable-rollback target are all tier-scoped, because a
+  quality checkpoint does not fit an edge replica's param tree.
 * **One metrics plane.** Fleet counters (`fleet.*`), per-tenant
   (`serve.tenant.<t>.*`) and the per-replica engine registries are all
   obs.metrics registries; `$OBS_METRICS` exports the fleet registry
@@ -100,6 +117,7 @@ PENALTY_DEGRADED = 1_000.0
 PENALTY_DRAINING = 1_000_000.0
 
 DEFAULT_TENANT = "default"
+DEFAULT_TIER = "default"
 
 _TENANT_RE = re.compile(r"[^A-Za-z0-9_-]")
 
@@ -170,12 +188,14 @@ class FleetFuture:
 
 
 class _Replica:
-    __slots__ = ("rid", "engine", "generation")
+    __slots__ = ("rid", "engine", "generation", "tier")
 
-    def __init__(self, rid: int, engine: ServingEngine):
+    def __init__(self, rid: int, engine: ServingEngine,
+                 tier: str = DEFAULT_TIER):
         self.rid = rid
         self.engine = engine
         self.generation = 0
+        self.tier = tier
 
 
 class _Tenant:
@@ -197,12 +217,14 @@ class _Tenant:
 
 
 class _Request:
-    __slots__ = ("image", "future", "attempts")
+    __slots__ = ("image", "future", "attempts", "tier")
 
-    def __init__(self, image: np.ndarray, future: FleetFuture):
+    def __init__(self, image: np.ndarray, future: FleetFuture,
+                 tier: Optional[str] = None):
         self.image = image
         self.future = future
         self.attempts = 0  # re-dispatches consumed
+        self.tier = tier   # tier pin (ISSUE 13): None = any replica
 
 
 class FleetRouter:
@@ -239,6 +261,9 @@ class FleetRouter:
                                                  ServingEngine],
                  n_replicas: int, variables=None,
                  tenants: Optional[Dict[str, int]] = None,
+                 replica_tiers: Optional[Sequence[str]] = None,
+                 tenant_tiers: Optional[Dict[str, str]] = None,
+                 tier_fallback: bool = False,
                  default_budget: int = 64, max_redispatch: int = 2,
                  deadline_ms: Optional[float] = None,
                  tenant_shed_requests: Optional[int] = None,
@@ -252,7 +277,34 @@ class FleetRouter:
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1, got %d" % n_replicas)
         self._factory = replica_factory
-        self._stable_variables = variables
+        tiers = list(replica_tiers) if replica_tiers is not None \
+            else [DEFAULT_TIER] * int(n_replicas)
+        if len(tiers) != int(n_replicas):
+            raise ValueError(
+                "replica_tiers must name every slot: %d tiers for %d "
+                "replicas" % (len(tiers), n_replicas))
+        self._tiers = [str(t) for t in tiers]
+        self._tier_fallback = bool(tier_fallback)
+        self._tenant_tiers = {
+            _sanitize_tenant(k): str(v)
+            for k, v in (tenant_tiers or {}).items()}
+        unknown = set(self._tenant_tiers.values()) - set(self._tiers)
+        if unknown:
+            raise ValueError(
+                "tenant_tiers name tier(s) with no replica slot: %s "
+                "(replica tiers: %s)"
+                % (sorted(unknown), sorted(set(self._tiers))))
+        # stable weights are PER TIER (a quality checkpoint cannot fit an
+        # edge replica's param tree); a plain pytree `variables` applies
+        # to every tier — the homogeneous-fleet (pre-tier) behavior
+        if isinstance(variables, dict) and variables \
+                and set(variables) <= set(self._tiers):
+            self._stable_variables = dict(variables)
+        elif variables is not None:
+            self._stable_variables = {t: variables
+                                      for t in set(self._tiers)}
+        else:
+            self._stable_variables = {}
         self._max_redispatch = max(0, int(max_redispatch))
         self._deadline_ms = deadline_ms
         self._default_budget = max(1, int(default_budget))
@@ -275,7 +327,8 @@ class FleetRouter:
 
         self._lock = threading.Lock()
         self._replicas: List[_Replica] = [
-            _Replica(rid, self._spawn(rid, start=start))
+            _Replica(rid, self._spawn(rid, start=start),
+                     tier=self._tiers[rid])
             for rid in range(int(n_replicas))]
         self._mg_replicas.set(len(self._replicas))
         self._tenants: Dict[str, _Tenant] = {}
@@ -351,10 +404,11 @@ class FleetRouter:
                        for t in self._tenants.values()}
         return {
             "replicas": [dict(rid=rep.rid, generation=rep.generation,
-                              canary=(canary is rep),
+                              tier=rep.tier, canary=(canary is rep),
                               **rep.engine.health(include_metrics=False))
                          for rep in reps],
             "tenants": tenants,
+            "tenant_tiers": dict(self._tenant_tiers),
             "canary": (None if canary is None
                        else {"rid": canary.rid,
                              "frac": self._canary_frac}),
@@ -411,7 +465,8 @@ class FleetRouter:
         return score, state
 
     def _candidates(self, exclude_engines: set,
-                    to_canary: bool) -> List[_Replica]:
+                    to_canary: bool,
+                    tier: Optional[str] = None) -> List[_Replica]:
         """Replicas in dispatch order: canary-first for the canary slice,
         else least-loaded among non-canary (canary excluded from the
         non-canary share so its observation window stays ~frac), with
@@ -424,6 +479,12 @@ class FleetRouter:
         with self._lock:
             reps = list(self._replicas)
             canary = self._canary
+        if tier is not None:
+            # tier pin (ISSUE 13): STRICT — a wrong-tier answer is a
+            # wrong result; tier_fallback opts into any-tier fallback
+            tiered = [rep for rep in reps if rep.tier == tier]
+            if tiered or not self._tier_fallback:
+                reps = tiered
         scored = []
         for rep in reps:
             if id(rep.engine) in exclude_engines:
@@ -468,7 +529,8 @@ class FleetRouter:
             self._shed(req, "deadline", SheddedError(
                 "deadline passed before fleet dispatch"))
             return True  # resolved (as a shed), not a capacity miss
-        for rep in self._candidates(exclude_engines, to_canary):
+        for rep in self._candidates(exclude_engines, to_canary,
+                                    tier=req.tier):
             eng = rep.engine  # pin: a respawn may swap rep.engine later
             try:
                 sf = eng.submit(req.image, deadline_s=remaining,
@@ -556,7 +618,8 @@ class FleetRouter:
 
     def submit(self, image: np.ndarray, tenant: str = DEFAULT_TENANT,
                deadline_s: Optional[float] = None,
-               block: bool = False) -> FleetFuture:
+               block: bool = False,
+               tier: Optional[str] = None) -> FleetFuture:
         """Route one request. Admission is per-tenant (budget + penalty
         box) then per-fleet (every replica's queue full => capacity
         shed); an admitted request is ACKNOWLEDGED — it completes with a
@@ -565,14 +628,24 @@ class FleetRouter:
         replica queue (engine submits use block=False — blocking the
         router on one replica would stall every tenant); the `block`
         parameter exists for ServingEngine.submit API compatibility (the
-        serve_bench load loops drive either) and is ignored."""
+        serve_bench load loops drive either) and is ignored.
+
+        `tier` (ISSUE 13) pins the request to that tier's replicas;
+        unset, the tenant's `tenant_tiers` policy applies (bulk tenants
+        -> cheap tier, flagged -> quality — the ROADMAP interplay); a
+        tenant with no policy routes fleet-wide as before."""
         del block  # API-compat only: a router shed is always immediate
         if self._closing:
             raise EngineClosedError("fleet router closed")
         tenant = _sanitize_tenant(tenant)
+        if tier is None:
+            tier = self._tenant_tiers.get(tenant)
+        elif tier not in set(self._tiers):
+            raise ValueError("unknown tier %r (replica tiers: %s)"
+                             % (tier, sorted(set(self._tiers))))
         fut = FleetFuture(tenant, deadline=None if deadline_s is None
                           else time.monotonic() + float(deadline_s))
-        req = _Request(np.asarray(image), fut)
+        req = _Request(np.asarray(image), fut, tier=tier)
         self._mc["submitted"].inc()
         # fleet:replica chaos: a worker-death kills the replica the
         # request WOULD have routed to (submit path only — never from an
@@ -619,8 +692,10 @@ class FleetRouter:
         return fut
 
     def predict_many(self, images: Sequence[np.ndarray],
-                     tenant: str = DEFAULT_TENANT) -> List:
-        futs = [self.submit(img, tenant=tenant) for img in images]
+                     tenant: str = DEFAULT_TENANT,
+                     tier: Optional[str] = None) -> List:
+        futs = [self.submit(img, tenant=tenant, tier=tier)
+                for img in images]
         return [f.result() for f in futs]
 
     # ---- replica death / respawn -----------------------------------------
@@ -652,10 +727,12 @@ class FleetRouter:
         self._tracer.event("fleet:replica-death", rid=rid,
                            reason=str(reason)[:200])
         fresh = self._spawn(rid, start=True)
-        if self._stable_variables is not None:
+        stable = self._stable_variables.get(rep.tier)
+        if stable is not None:
             # a respawn mid-rollout (or post-promote) must not resurrect
-            # the factory's original weights
-            fresh.reload(self._stable_variables)
+            # the factory's original weights — per-TIER stable weights
+            # (a quality checkpoint cannot fit an edge replica)
+            fresh.reload(stable)
         with self._lock:
             rep.engine = fresh
             rep.generation += 1
@@ -670,22 +747,38 @@ class FleetRouter:
 
     def rollout(self, variables, canary_frac: float = 0.25,
                 window: int = 16, timeout_s: float = 60.0,
-                poll_s: float = 0.002) -> Dict:
+                poll_s: float = 0.002,
+                tier: Optional[str] = None) -> Dict:
         """Canary rollout (module docstring): swap ONE replica to
         `variables`, watch `window` post-swap completions on the canary
         slice, promote to the rest on a clean window, roll back on any
         canary `alert:*` (or canary death). Blocking control path —
         traffic flows from other threads meanwhile (mirrors
-        engine.drain's polling discipline). Returns the outcome dict."""
+        engine.drain's polling discipline). Returns the outcome dict.
+
+        On a heterogeneous (multi-tier) fleet `tier` is REQUIRED: the
+        canary pick, the promote fan-out and the rollback target are all
+        scoped to that tier's replicas — a quality checkpoint does not
+        fit an edge replica's param tree."""
         from ..obs.slo import (ErrorBurnRule, LatencyBurnRule,
                                SloWatchdog)
-        if self._stable_variables is None:
+        fleet_tiers = set(self._tiers)
+        if tier is None:
+            if len(fleet_tiers) > 1:
+                raise ValueError(
+                    "rollout on a multi-tier fleet needs tier=: replica "
+                    "tiers are %s" % sorted(fleet_tiers))
+            tier = next(iter(fleet_tiers))
+        elif tier not in fleet_tiers:
+            raise ValueError("unknown tier %r (replica tiers: %s)"
+                             % (tier, sorted(fleet_tiers)))
+        if self._stable_variables.get(tier) is None:
             raise ValueError("rollout needs the stable checkpoint: "
                              "construct FleetRouter(variables=...)")
         with self._lock:
             if self._canary is not None:
                 raise RuntimeError("a rollout is already in progress")
-            reps = list(self._replicas)
+            reps = [r for r in self._replicas if r.tier == tier]
         frac = min(1.0, max(0.0, float(canary_frac)))
         # deterministic pick: healthiest (lowest score), lowest rid
         scored = sorted((ss[0], r.rid, r) for ss, r in
@@ -736,7 +829,7 @@ class FleetRouter:
                 if done >= max(1, int(window)):
                     outcome = PROMOTED
                     self._end_canary(canary)
-                    self._promote(canary, variables)
+                    self._promote(canary, variables, tier)
                     break
                 time.sleep(poll_s)
             else:
@@ -776,22 +869,26 @@ class FleetRouter:
             self._tracer.event("fleet:reload-timeout", rid=rep.rid)
             self.kill_replica(rep.rid, reason="reload drain timeout")
 
-    def _promote(self, canary: _Replica, variables) -> None:
+    def _promote(self, canary: _Replica, variables,
+                 tier: str) -> None:
         with self._lock:
-            others = [r for r in self._replicas if r is not canary]
+            others = [r for r in self._replicas
+                      if r is not canary and r.tier == tier]
         # stable flips FIRST: a respawn fallback (or a concurrent death)
-        # during the fan-out must come up on the NEW weights
-        self._stable_variables = variables
+        # during the fan-out must come up on the NEW weights; only THIS
+        # tier's stable entry moves (other tiers keep their checkpoints)
+        self._stable_variables[tier] = variables
         for rep in others:
             self._reload_or_respawn(rep, variables)
         self._mc["promotes"].inc()
-        self._tracer.event("fleet:promote", rid=canary.rid,
+        self._tracer.event("fleet:promote", rid=canary.rid, tier=tier,
                            replicas=len(others) + 1)
 
     def _rollback(self, canary: _Replica, died: bool, reason: str,
                   wd) -> None:
         if not died:
-            self._reload_or_respawn(canary, self._stable_variables)
+            self._reload_or_respawn(canary,
+                                    self._stable_variables[canary.tier])
         # a dead canary was already respawned at the STABLE weights by
         # kill_replica — the rollback is the respawn itself
         self._mc["rollbacks"].inc()

@@ -138,8 +138,113 @@ def resolve_loss_kernel(cfg: Config) -> str:
     return mode
 
 
+class Distiller:
+    """Teacher half of `--distill` (ISSUE 13): the flagship checkpoint's
+    forward pass, run INSIDE the student's jitted step under
+    `stop_gradient`, plus the soft-target loss mixing its last stack's
+    heatmap/offset/size into the student's deep-supervision loss.
+
+    Design constraints, each load-bearing:
+
+    * the teacher variables are CLOSED OVER (trace-time constants), so
+      every step body/runner/scan signature — and therefore the donation
+      and sharding contracts — is byte-identical to the non-distill
+      program; `--distill` off traces the exact pre-PR step (bit-identity
+      pinned by tests/test_tiers.py);
+    * fixed shapes: teacher and student share imsize/scale_factor/num_cls,
+      so the soft targets are the student's own (B, H/4, W/4, C+4) map —
+      no dynamic anything, composes with --grad-accum's micro-batch scan
+      and --sentinel's skip-select unchanged;
+    * the soft-loss scalars join the step's losses dict and ride the SAME
+      deferred loss fetch as every other component (zero extra D2H — the
+      --telemetry contract);
+    * soft losses reuse the hard loss's own normalizations (focal-style
+      num_pos for the heatmap MSE, `normed_l1_loss` for offset/size) so
+      `--distill-alpha` weighs comparable magnitudes.
+    """
+
+    def __init__(self, model, params, batch_stats, alpha: float,
+                 num_cls: int, normalized_coord: bool):
+        self.model = model
+        self.params = params
+        self.batch_stats = batch_stats
+        self.alpha = float(alpha)
+        self.num_cls = int(num_cls)
+        self.normalized = bool(normalized_coord)
+
+    def soft_targets(self, images):
+        """Teacher last-stack soft targets (heat, offset, size), all under
+        stop_gradient — the backward never touches the teacher graph."""
+        out = self.model.apply(
+            {"params": self.params, "batch_stats": self.batch_stats},
+            images, train=False)
+        return split_stack_predictions(
+            jax.lax.stop_gradient(out[:, -1]), self.num_cls,
+            self.normalized)
+
+    def soft_losses(self, student_out, images, mask, cfg: Config):
+        """Per-student-stack soft loss vs the teacher's last stack."""
+        from .ops.loss import normed_l1_loss
+        t_heat, t_off, t_size = self.soft_targets(images)
+        t_heat = t_heat.astype(jnp.float32)
+        num_pos = jnp.clip(jnp.sum(mask), 1.0, 1e30)
+        hm = jnp.float32(0.0)
+        off = jnp.float32(0.0)
+        size = jnp.float32(0.0)
+        for s in range(student_out.shape[1]):
+            s_heat, s_off, s_size = split_stack_predictions(
+                student_out[:, s], self.num_cls, self.normalized)
+            # heatmap: dense MSE on the sigmoid maps, focal-normalized
+            # (sum over HWC, batch mean, / global positive count) so it
+            # lives on the hard focal loss's scale
+            d = jnp.square(s_heat.astype(jnp.float32) - t_heat)
+            hm = hm + jnp.sum(d, axis=(1, 2, 3)).mean() / num_pos
+            # offset/size: the hard loss's own masked-L1 against teacher
+            # regressions (only GT centers carry signal in these maps)
+            off = off + normed_l1_loss(s_off, t_off, mask)
+            size = size + normed_l1_loss(s_size, t_size, mask)
+        total = (hm * cfg.hm_weight + off * cfg.offset_weight
+                 + size * cfg.size_weight)
+        return {"hm": hm, "offset": off, "size": size, "total": total}
+
+
+def make_distiller(cfg: Config) -> Optional[Distiller]:
+    """Build the `--distill` teacher from its checkpoint, or None.
+
+    Teacher ARCHITECTURE comes from the checkpoint dir's argument.json
+    snapshot (the eval-restore path, config.update_config_for_eval), so a
+    flagship stack2 teacher distills into an edge-tier student without
+    any teacher flags on the student's command line."""
+    path = getattr(cfg, "distill", None)
+    if not path:
+        return None
+    import dataclasses
+    from .config import load_config, update_config_for_eval
+    path = resolve_model_load(path)
+    tcfg = cfg
+    snap = os.path.join(os.path.dirname(os.path.abspath(path)),
+                        "argument.json")
+    if os.path.exists(snap):
+        tcfg = update_config_for_eval(cfg, load_config(snap))
+    else:
+        print("%s: --distill %s has no argument.json; assuming the "
+              "student's own architecture" % (timestamp(), path),
+              flush=True)
+    tmodel = build_model(tcfg, dtype=jnp.bfloat16 if cfg.amp else None)
+    imsize = cfg.imsize or cfg.multiscale[1]
+    p_tmpl, bs_tmpl = init_variables(tmodel, jax.random.key(0), imsize)
+    params, batch_stats = restore_variables(path, p_tmpl, bs_tmpl)
+    print("%s: --distill teacher %s (variant=%s stacks=%d width=%d, "
+          "alpha=%g)" % (timestamp(), path,
+                         getattr(tcfg, "variant", "residual"),
+                         tcfg.num_stack, tcfg.hourglass_inch,
+                         cfg.distill_alpha), flush=True)
+    return Distiller(tmodel, params, batch_stats, cfg.distill_alpha,
+                     cfg.num_cls, cfg.normalized_coord)
+
+
 def loss_fn(params, batch_stats, model, images, gt_heat, gt_off, gt_wh, mask,
-            cfg: Config):
+            cfg: Config, distill: Optional[Distiller] = None):
     """Forward + deep-supervision loss over all stacks (ref train.py:99-120).
 
     Two step-compression levers hook in here (both numerically pinned by
@@ -148,7 +253,13 @@ def loss_fn(params, batch_stats, model, images, gt_heat, gt_off, gt_wh, mask,
     activation (stem/neck/head included, beyond what the in-model
     per-stack nn.remat covers) so batch 32/64 @512^2 fits HBM; and
     `--loss-kernel` picks the XLA loss composition or the one-pass Pallas
-    fused kernel (ops/pallas/loss.py)."""
+    fused kernel (ops/pallas/loss.py).
+
+    `distill` (ISSUE 13): the teacher's soft-target loss joins the hard
+    loss at weight `--distill-alpha`; the teacher forward runs under
+    stop_gradient OUTSIDE any remat wrapper (it has no backward to
+    recompute, so rematerializing it would only re-run a gradient-free
+    forward)."""
     def apply_fn(p, bs, im):
         return model.apply({"params": p, "batch_stats": bs}, im,
                            train=True, mutable=["batch_stats"])
@@ -169,6 +280,10 @@ def loss_fn(params, batch_stats, model, images, gt_heat, gt_off, gt_wh, mask,
         totals = stacked_detection_loss(
             out, gt_heat, gt_off, gt_wh, mask, num_cls=cfg.num_cls,
             normalized_coord=cfg.normalized_coord, **kw)
+    if distill is not None:
+        soft = distill.soft_losses(out, images, mask, cfg)
+        totals["distill"] = soft["total"]
+        totals["total"] = totals["total"] + distill.alpha * soft["total"]
     return totals["total"], (mutated.get("batch_stats", batch_stats), totals)
 
 
@@ -241,7 +356,7 @@ def _sentinel_update(cfg: Config, state: TrainState, tx, grads, batch_stats,
     return out_state, out_losses
 
 
-def _make_accum_step_body(model, tx, cfg: Config):
+def _make_accum_step_body(model, tx, cfg: Config, distill=None):
     """`--grad-accum k` train-step body (ISSUE 11): the global batch is
     split into `k` equal micro-batches scanned INSIDE the jitted step —
     per-micro fwd+bwd with gradients accumulated in fp32 (a bf16
@@ -278,7 +393,7 @@ def _make_accum_step_body(model, tx, cfg: Config):
 
             def lf(p, b):
                 total, aux = loss_fn(p, b, model, images, gt_heat, gt_off,
-                                     gt_wh, mask, cfg)
+                                     gt_wh, mask, cfg, distill=distill)
                 if loss_scale is not None:
                     total = total * loss_scale
                 return total, aux
@@ -324,7 +439,7 @@ def _make_accum_step_body(model, tx, cfg: Config):
     return step
 
 
-def make_train_step_body(model, tx, cfg: Config):
+def make_train_step_body(model, tx, cfg: Config, distill=None):
     """The un-jitted train-step body: fwd + bwd + optimizer update.
 
     Exposed separately from `make_train_step` so callers that need the step
@@ -344,13 +459,13 @@ def make_train_step_body(model, tx, cfg: Config):
     pre-PR body (bit-identity pinned by tests/test_sentinel.py); the
     built step carries `step.sentinel` so wrappers (scan, runners) adapt."""
     if getattr(cfg, "grad_accum", 1) > 1:
-        return _make_accum_step_body(model, tx, cfg)
+        return _make_accum_step_body(model, tx, cfg, distill=distill)
     if not getattr(cfg, "sentinel", False):
         def step(state: TrainState, images, gt_heat, gt_off, gt_wh, mask):
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
             (_, (batch_stats, losses)), grads = grad_fn(
                 state.params, state.batch_stats, model, images, gt_heat,
-                gt_off, gt_wh, mask, cfg)
+                gt_off, gt_wh, mask, cfg, distill)
             new_state = _optimizer_update(state, tx, cfg, grads, batch_stats)
             return new_state, _maybe_telemetry(cfg, losses, grads,
                                                state.params, new_state)
@@ -362,7 +477,8 @@ def make_train_step_body(model, tx, cfg: Config):
              loss_scale):
         def scaled_loss(params, batch_stats):
             total, aux = loss_fn(params, batch_stats, model, images,
-                                 gt_heat, gt_off, gt_wh, mask, cfg)
+                                 gt_heat, gt_off, gt_wh, mask, cfg,
+                                 distill)
             return total * loss_scale, aux
 
         grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
@@ -499,14 +615,14 @@ def make_state_accum_flush(cfg: Config, steps_per_epoch: int):
     return run
 
 
-def make_train_step(model, tx, cfg: Config, mesh):
+def make_train_step(model, tx, cfg: Config, mesh, distill=None):
     """Build the jitted, mesh-partitioned train step.
 
     Batch arrays are sharded (data[, spatial]); state is replicated. The
     gradient all-reduce the reference gets from DDP hooks
     (ref train.py:174-175) falls out of GSPMD partitioning here.
     """
-    step = make_train_step_body(model, tx, cfg)
+    step = make_train_step_body(model, tx, cfg, distill=distill)
     repl = replicated(mesh)
     # Shardings: state fully replicated; image NHWC and target maps shard
     # (data on B, spatial on H). The sentinel body's trailing loss_scale
@@ -523,7 +639,8 @@ def make_train_step(model, tx, cfg: Config, mesh):
         donate_argnums=(0,))
 
 
-def make_device_step_body(model, tx, cfg: Config, target: int):
+def make_device_step_body(model, tx, cfg: Config, target: int,
+                          distill=None):
     """Un-jitted fused-input step: on-device augmentation, GT encoding and
     normalization followed by fwd/bwd/update. Shared by the streaming
     (`make_device_train_step`) and HBM-cached (`make_cached_device_train_
@@ -560,7 +677,7 @@ def make_device_step_body(model, tx, cfg: Config, target: int):
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
             (_, (batch_stats, losses)), grads = grad_fn(
                 state.params, state.batch_stats, model, img, heat, off, wh,
-                mask, cfg)
+                mask, cfg, distill)
             new_state = _optimizer_update(state, tx, cfg, grads, batch_stats)
             return new_state, _maybe_telemetry(cfg, losses, grads,
                                                state.params, new_state)
@@ -575,7 +692,7 @@ def make_device_step_body(model, tx, cfg: Config, target: int):
 
         def scaled_loss(params, batch_stats):
             total, aux = loss_fn(params, batch_stats, model, img, heat,
-                                 off, wh, mask, cfg)
+                                 off, wh, mask, cfg, distill)
             return total * loss_scale, aux
 
         grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
@@ -589,13 +706,14 @@ def make_device_step_body(model, tx, cfg: Config, target: int):
     return step
 
 
-def make_device_train_step(model, tx, cfg: Config, mesh, target: int):
+def make_device_train_step(model, tx, cfg: Config, mesh, target: int,
+                           distill=None):
     """Train step with the input pipeline fused in: on-device augmentation,
     GT encoding and normalization followed by fwd/bwd/update — ONE XLA
     program per multiscale bucket. The host only decodes JPEGs and resizes
     to the canvas (data/augment_device.py; ≡ imgaug + box2hm + normalize of
     ref data.py:93-125 moved onto the accelerator)."""
-    step = make_device_step_body(model, tx, cfg, target)
+    step = make_device_step_body(model, tx, cfg, target, distill=distill)
     repl = replicated(mesh)
     img_sh = batch_sharding(mesh, 4)     # gather-based warp: no spatial shard
     box_sh = batch_sharding(mesh, 3)
@@ -608,7 +726,7 @@ def make_device_train_step(model, tx, cfg: Config, mesh, target: int):
 
 
 def make_cached_device_train_step(model, tx, cfg: Config, mesh, target: int,
-                                  cache):
+                                  cache, distill=None):
     """Fused step over the HBM-resident dataset (`--cache-device`): the
     host sends only a `(B,)` int32 index vector per step; the batch is
     gathered from the replicated device cache, then augmented/encoded/
@@ -617,7 +735,7 @@ def make_cached_device_train_step(model, tx, cfg: Config, mesh, target: int,
     Steady-state host->device traffic: B*4 bytes instead of the
     ~B*canvas^2*3 raw pixels of the streaming path — the input pipeline
     cannot be the bottleneck at any batch size."""
-    body = make_device_step_body(model, tx, cfg, target)
+    body = make_device_step_body(model, tx, cfg, target, distill=distill)
     sentinel = getattr(body, "sentinel", False)
 
     def step(state: TrainState, key, step_idx, images_all, boxes_all,
@@ -953,7 +1071,7 @@ def make_snapshot_fn(model, cfg: Config):
 
 
 def make_step_runner(cfg: Config, mesh, model, tx, cache=None,
-                     sentinel_scale=None):
+                     sentinel_scale=None, distill=None):
     """Build `runner(state, batch, step_idx) -> (state, losses)` for the
     configured input path.
 
@@ -988,7 +1106,7 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None,
         return (np.float32(scale_of()),) if sentinel else ()
 
     if not cfg.device_augment:
-        step = make_train_step(model, tx, cfg, mesh)
+        step = make_train_step(model, tx, cfg, mesh, distill=distill)
 
         def stage(batch):
             return shard_batch(
@@ -1052,7 +1170,7 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None,
         def get_step(target):
             if target not in steps:
                 steps[target] = make_cached_device_train_step(
-                    model, tx, cfg, mesh, target, cache)
+                    model, tx, cfg, mesh, target, cache, distill=distill)
             return steps[target]
 
         def runner(state, idx_batch, step_idx):
@@ -1070,7 +1188,7 @@ def make_step_runner(cfg: Config, mesh, model, tx, cache=None,
     def get_step(target):
         if target not in steps:
             steps[target] = make_device_train_step(model, tx, cfg, mesh,
-                                                   target)
+                                                   target, distill=distill)
         return steps[target]
 
     def stage(batch):
@@ -1679,9 +1797,11 @@ def train(cfg: Config, chaos=None) -> TrainState:
     # the runner reads its loss scale per call (tracer attached below,
     # once the flight recorder exists)
     monitor = SentinelMonitor(cfg) if cfg.sentinel else None
+    distill = make_distiller(cfg)
     runner = make_step_runner(
         cfg, mesh, model, tx, cache=cache,
-        sentinel_scale=monitor.scale_value if monitor else None)
+        sentinel_scale=monitor.scale_value if monitor else None,
+        distill=distill)
     if cfg.prewarm:
         if hasattr(runner, "prewarm"):
             if is_chief:
@@ -1931,7 +2051,8 @@ def train(cfg: Config, chaos=None) -> TrainState:
                         loader = cache
                 runner = make_step_runner(
                     cfg, mesh, model, tx, cache=cache,
-                    sentinel_scale=monitor.scale_value if monitor else None)
+                    sentinel_scale=monitor.scale_value if monitor else None,
+                    distill=distill)
                 # only checkpoints written by THIS run are trusted: a
                 # reused save_path can hold a previous run's (possibly
                 # later-epoch) checkpoints, which would silently replace

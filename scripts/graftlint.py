@@ -469,6 +469,24 @@ def _selfcheck_trace(check) -> None:
                         "train_step_scanned[param=bf16-compute]",
                         donate_argnums=(0,))
     check("bf16-policy scanned step audits clean", not pf)
+
+    # the tier-variant entry points (ISSUE 13): smallest (edge/depthwise)
+    # and largest (quality/residual stack2) tier — train step + predict
+    # must audit as clean as the flagship surfaces they sit beside (the
+    # repo baseline stays EMPTY: anything these raise gets FIXED)
+    for tier, arch in ta.TIER_AUDIT:
+        train_t, targs_t = ta._tiny_train_parts("none", arch=arch)
+        tf = ta.audit_entry(train_t, targs_t,
+                            "train_step_scanned[tier=%s]" % tier,
+                            donate_argnums=(0,), lower=tier == "edge")
+        check("tier=%s scanned step audits clean" % tier, not tf)
+        predict_t, variables_t, images_t = ta._tiny_predict_parts(
+            arch=arch)
+        pf_t = ta.audit_entry(lambda v, im, _p=predict_t: _p(v, im),
+                              (variables_t, images_t),
+                              "predict[tier=%s]" % tier,
+                              lower=tier == "edge")
+        check("tier=%s predict audits clean" % tier, not pf_t)
     predict_e, variables_e, images_e = ta._tiny_predict_parts(
         epilogue="fused")
     ef = ta.audit_entry(lambda v, im: predict_e(v, im),

@@ -133,6 +133,13 @@ TOLERANCE = {
     # residual cache/scheduling noise between the two runs of a ratio
     # doesn't trip it)
     "eff": {"default": 0.15},
+    # per-tier fixture mAP (quality-matrix-v2, ISSUE 13): an ABSOLUTE
+    # delta-mAP bound, not relative — mAP lives on [0, 1] where relative
+    # tolerances misbehave near small references, and a -3 pt quality
+    # regression must FAIL regardless of platform (the fixture eval is
+    # deterministic given the seed/config; 2 pts absorbs
+    # training-stochasticity wiggle). gate() special-cases the class.
+    "quality": {"default": 0.02},
 }
 
 
@@ -188,13 +195,18 @@ def _bench_sig(rec: Dict) -> str:
     # "xla" loss-kernel/epilogue IS the unlevered pre-PR program, so it
     # keys identically to historical lines that predate those fields —
     # only a genuinely different program (fused kernels, bf16 params,
-    # remat, sentinel) forks the trajectory
+    # remat, sentinel, a non-flagship tier arch) forks the trajectory
     for field, defaults, tag in (
             ("remat", ("none",), "remat"),
             ("loss_kernel", ("auto", "xla"), "lk"),
             ("param_policy", ("fp32",), "pp"),
             ("epilogue", ("auto", "xla"), "epi"),
-            ("sentinel", ("off",), "sent")):
+            ("sentinel", ("off",), "sent"),
+            # arch fields (ISSUE 13): flagship defaults = the historical
+            # bench program, so pre-tier lines keep their keys
+            ("variant", ("residual",), "var"),
+            ("num_stack", (1,), "s"),
+            ("width", (128,), "w")):
         val = rec.get(field)
         if val is not None and val not in defaults:
             parts.append("%s=%s" % (tag, val))
@@ -285,6 +297,16 @@ def obs_from_roofline(d: Dict, rnd: int, source: str) -> List[Obs]:
     sig = "%s,%s,b%s,pp=%s,epi=%s" % (
         platform, cfg.get("imsize", "?"), cfg.get("batch", "?"),
         cfg.get("param_policy", "fp32"), cfg.get("epilogue", "auto"))
+    # mode/arch discriminators (ISSUE 13): absent on historical artifacts
+    # and at their train/flagship defaults, so old keys stay stable
+    if cfg.get("mode", "train") != "train":
+        sig += ",mode=%s" % cfg["mode"]
+    if cfg.get("variant", "residual") != "residual":
+        sig += ",var=%s" % cfg["variant"]
+    if cfg.get("num_stack", 1) != 1:
+        sig += ",s=%s" % cfg["num_stack"]
+    if cfg.get("width", 128) != 128:
+        sig += ",w=%s" % cfg["width"]
     out = []
     summary = d.get("summary") or {}
     total = summary.get("total_bytes")
@@ -337,6 +359,42 @@ def obs_from_scaling(d: Dict, rnd: int, source: str) -> List[Obs]:
             HIGHER, "rate")
         add("%s_sharding_eff" % tag, e.get("sharding_efficiency"),
             HIGHER, "eff")
+    return out
+
+
+def obs_from_quality_matrix(d: Dict, rnd: int, source: str) -> List[Obs]:
+    """quality-matrix-v2 tier rows (ISSUE 13): per-tier fixture mAP in
+    the ABSOLUTE `quality` class (a -3 pt tier regression fails on any
+    platform), per-tier serve-wire latency (time class — wide off-chip),
+    and the tier's counting-model predict bytes (deterministic — the
+    tight bytes class). Keyed on tier + the row's actual arch + the
+    fixture scale, so a smoke-scale row never gates a chip-scale one."""
+    if d.get("schema") != "quality-matrix-v2":
+        return []
+    meta = d.get("tier_meta") or {}
+    platform = meta.get("platform") or "?"
+    base = "%s,%s%s" % (platform, meta.get("imsize", "?"),
+                        ",smoke" if meta.get("smoke") else "")
+    out = []
+    for tier, row in (d.get("tiers") or {}).items():
+        if not isinstance(row, dict):
+            continue
+        arch = row.get("arch") or {}
+        sig = "%s,%s,%s,s%s,w%s" % (base, tier,
+                                    arch.get("variant", "?"),
+                                    arch.get("num_stack", "?"),
+                                    arch.get("width", "?"))
+        if isinstance(row.get("mAP"), (int, float)):
+            out.append(Obs("quality[%s].map" % sig, row["mAP"], HIGHER,
+                           "quality", platform, rnd, source))
+        if isinstance(row.get("serve_wire_ms_b1"), (int, float)):
+            out.append(Obs("quality[%s].serve_wire_ms_b1" % sig,
+                           row["serve_wire_ms_b1"], LOWER, "time",
+                           platform, rnd, source))
+        if isinstance(row.get("predict_bytes"), (int, float)):
+            out.append(Obs("quality[%s].predict_bytes" % sig,
+                           row["predict_bytes"], LOWER, "bytes",
+                           platform, rnd, source))
     return out
 
 
@@ -410,6 +468,14 @@ def scan_observations(root: str) -> List[Obs]:
         except (OSError, json.JSONDecodeError):
             continue
         out += obs_from_scaling(d, _round_of(path), rel(path))
+    for path in sorted(glob.glob(os.path.join(
+            root, "artifacts", "*", "quality_matrix*.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        out += obs_from_quality_matrix(d, _round_of(path), rel(path))
     for path in sorted(glob.glob(os.path.join(
             root, "artifacts", "*", "obs", "metrics*.jsonl"))):
         out += obs_from_metrics_jsonl(path, _round_of(path), rel(path))
@@ -491,7 +557,15 @@ def gate(current: Dict[str, Obs], ledger: Dict) -> Dict:
         tol = tolerance_for(ref.get("class", "rate"),
                             ref.get("platform", "default"))
         ref_v = float(ref["value"])
-        if ref.get("direction", HIGHER) == HIGHER:
+        if ref.get("class") == "quality":
+            # ABSOLUTE delta bound (see TOLERANCE): mAP lives on [0, 1]
+            if ref.get("direction", HIGHER) == HIGHER:
+                bad = ob.value < ref_v - tol
+                better = ob.value > ref_v
+            else:
+                bad = ob.value > ref_v + tol
+                better = ob.value < ref_v
+        elif ref.get("direction", HIGHER) == HIGHER:
             bad = ob.value < ref_v * (1.0 - tol)
             better = ob.value > ref_v
         else:
@@ -543,6 +617,8 @@ def candidate_observations(path: str) -> List[Obs]:
         return obs_from_roofline(d, rnd, path)
     if d.get("schema") == "scaling-v2":
         return obs_from_scaling(d, rnd, path)
+    if d.get("schema") == "quality-matrix-v2":
+        return obs_from_quality_matrix(d, rnd, path)
     if isinstance(d.get("parsed"), dict):
         d = d["parsed"]
     return obs_from_bench_line(d, rnd, path)
@@ -686,6 +762,28 @@ def _fixture_tree(tmp: str) -> None:
     jline(os.path.join(tmp, "artifacts", "r02", "serving",
                        "serve_bench_fleet.json"),
           _fleet_fixture(0.97, 776.0))
+    # quality-matrix-v2 tier rows (ISSUE 13): the per-tier mAP fixture a
+    # seeded -3 pt candidate must FAIL against (absolute quality class)
+    jline(os.path.join(tmp, "artifacts", "r02", "quality_matrix.json"),
+          _quality_fixture(0.71))
+
+
+def _quality_fixture(edge_map: float) -> Dict:
+    return {"schema": "quality-matrix-v2",
+            "tier_meta": {"platform": "cpu", "smoke": True, "imsize": 64,
+                          "n_train": 48, "n_test": 16, "epochs": 6,
+                          "width_scale": 8},
+            "tiers": {
+                "edge": {"arch": {"variant": "depthwise", "num_stack": 1,
+                                  "width": 8},
+                         "mAP": edge_map, "distilled": True,
+                         "serve_wire_ms_b1": 14.0,
+                         "predict_bytes": 5.0e7},
+                "quality": {"arch": {"variant": "residual",
+                                     "num_stack": 2, "width": 16},
+                            "mAP": 0.80, "distilled": False,
+                            "serve_wire_ms_b1": 55.0,
+                            "predict_bytes": 4.0e8}}}
 
 
 def _fleet_fixture(eff4: float, goodput4: float) -> Dict:
@@ -844,6 +942,23 @@ def selfcheck() -> int:
         check("fleet efficiency wiggle + cpu goodput dip pass",
               run(["--root", tmp, "--ledger", ledger,
                    "--candidate", ok_fleet]) == 0)
+        # the ISSUE 13 acceptance fixture: per-tier mAP gates in the
+        # ABSOLUTE quality class — a -3 pt edge-tier mAP candidate must
+        # FAIL (even on CPU, where relative time/rate classes are wide),
+        # while a -1 pt wiggle passes (inside the 2 pt absolute bound)
+        check("tier mAP tracked in the ledger",
+              "quality[cpu,64,smoke,edge,depthwise,s1,w8].map"
+              in load_ledger(ledger)["entries"])
+        bad_q = os.path.join(tmp, "cand_quality.json")
+        save_json(bad_q, _quality_fixture(round(0.71 - 0.03, 4)))
+        check("-3 pt tier mAP FAILS the gate",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", bad_q]) == 1)
+        ok_q = os.path.join(tmp, "cand_quality_ok.json")
+        save_json(ok_q, _quality_fixture(round(0.71 - 0.01, 4)))
+        check("-1 pt tier mAP wiggle passes",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", ok_q]) == 0)
         # within-tolerance chip wiggle and a 30%-slow CPU line both pass
         okc = os.path.join(tmp, "cand_ok.json")
         save_json(okc, {"platform": "tpu", "imsize": 512, "batch": 16,

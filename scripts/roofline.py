@@ -396,7 +396,10 @@ def classify(rows, peak: float, hbm: float, durations=None, steps: int = 1):
 
 
 def _markdown(rows, meta, top: int) -> str:
-    lines = ["# Roofline attribution — train step",
+    lines = ["# Roofline attribution — %s"
+             % ("predict (serve wire)"
+                if (meta["config"] or {}).get("mode") == "predict"
+                else "train step"),
              "",
              "platform=%s  config=%s" % (meta["platform"],
                                          json.dumps(meta["config"])),
@@ -454,6 +457,39 @@ def build_step(jax, args, loss_kernel: str):
     remake = lambda: create_train_state(  # noqa: E731 — donation refills
         model, cfg, jax.random.key(0), args.imsize, tx)
     return compiled, state, arrs, remake
+
+
+def build_predict(jax, args):
+    """`--mode predict` (ISSUE 13): the serve-wire predict program — raw
+    uint8 in, normalize on-device, network -> sigmoid -> decode -> NMS —
+    at the CLI architecture (variant/stacks/width), ONE batch shape. The
+    per-tier counting model behind the latency-tier Pareto table: the
+    quality_matrix tier rows and the edge-vs-flagship `--diff` evidence
+    both come from this program."""
+    import jax.numpy as jnp
+
+    from real_time_helmet_detection_tpu.config import Config
+    from real_time_helmet_detection_tpu.models import build_model
+    from real_time_helmet_detection_tpu.predict import make_predict_fn
+    from real_time_helmet_detection_tpu.train import init_variables
+
+    cfg = Config(num_stack=args.num_stack,
+                 hourglass_inch=args.hourglass_inch, num_cls=2,
+                 variant=args.variant,
+                 # tier geometry: the stem follows the model width below
+                 # 128 (config.TIER_PRESETS stem_width convention)
+                 stem_width=min(128, args.hourglass_inch),
+                 topk=100, conf_th=0.0, nms_th=0.5,
+                 imsize=args.imsize, epilogue=args.epilogue)
+    model = build_model(cfg, dtype=jnp.bfloat16)
+    params, batch_stats = init_variables(model, jax.random.key(0),
+                                         args.imsize)
+    variables = {"params": params, "batch_stats": batch_stats}
+    predict = make_predict_fn(model, cfg, normalize="imagenet")
+    images = jnp.zeros((args.batch, args.imsize, args.imsize, 3),
+                       jnp.uint8)
+    compiled = predict.lower(variables, images).compile()
+    return compiled, (variables, images)
 
 
 def loss_subprogram_cost(jax, args, kernel: str):
@@ -714,8 +750,17 @@ def main() -> None:
     ap.add_argument("--imsize", type=int, default=512)
     ap.add_argument("--num-stack", type=int, default=1)
     ap.add_argument("--hourglass-inch", type=int, default=128)
+    ap.add_argument("--mode", default="train",
+                    choices=["train", "predict"],
+                    help="train = the scanned train step (the default, "
+                         "the pre-tier behavior); predict = the serve-"
+                         "wire predict program (ISSUE 13: the per-tier "
+                         "counting model)")
+    ap.add_argument("--variant", default="residual",
+                    choices=["residual", "depthwise", "ghost"],
+                    help="residual-block variant (the latency-tier axis)")
     ap.add_argument("--steps", type=int, default=2,
-                    help="scan length of the traced program")
+                    help="scan length of the traced program (train mode)")
     ap.add_argument("--remat", default="none",
                     choices=["none", "stacks", "full"])
     ap.add_argument("--loss-kernel", default="auto",
@@ -768,7 +813,12 @@ def main() -> None:
     # phase boundaries — first compile on a remote transport is minutes
     hb = maybe_job_heartbeat()
     hb.beat("backend up (%s)" % platform)
-    compiled, state, arrs, remake = build_step(jax, args, args.loss_kernel)
+    predict_mode = args.mode == "predict"
+    if predict_mode:
+        compiled, pargs = build_predict(jax, args)
+    else:
+        compiled, state, arrs, remake = build_step(jax, args,
+                                                   args.loss_kernel)
     hb.beat("step compiled")
     total_flops, total_bytes_ca = flops_of(compiled), bytes_of(compiled)
     comps, fusion_bodies, appliers = parse_hlo(compiled.as_text())
@@ -776,7 +826,7 @@ def main() -> None:
     log("HLO: %d computations, %d reportable ops"
         % (len(comps), len(rows)))
     epilogue_counting = None
-    if platform != "tpu":
+    if platform != "tpu" and not predict_mode:
         # fused-epilogue analytic basis off-TPU (see the function's
         # docstring); on TPU the Pallas custom-calls are counted natively
         rows, epilogue_counting = substitute_epilogue_analytic(
@@ -796,10 +846,16 @@ def main() -> None:
         import tempfile
         tdir = tempfile.mkdtemp(prefix="roofline_trace_")
         try:
-            np.asarray(compiled(state, *arrs)[1])  # warmup (donates state)
-            st2 = remake()
-            jax.profiler.start_trace(tdir)
-            np.asarray(compiled(st2, *arrs)[1])
+            if predict_mode:
+                # no donation: the same args serve warmup and traced run
+                jax.tree.map(np.asarray, compiled(*pargs))  # warmup
+                jax.profiler.start_trace(tdir)
+                jax.tree.map(np.asarray, compiled(*pargs))
+            else:
+                np.asarray(compiled(state, *arrs)[1])  # warmup (donates)
+                st2 = remake()
+                jax.profiler.start_trace(tdir)
+                np.asarray(compiled(st2, *arrs)[1])
             jax.profiler.stop_trace()
             events = []
             for t in find_traces(tdir):
@@ -810,7 +866,8 @@ def main() -> None:
             trace_note = "trace failed: %s" % str(e).splitlines()[-1][:200]
             log(trace_note)
 
-    summary = classify(rows, peak, hbm, durations, steps=args.steps)
+    steps = 1 if predict_mode else args.steps
+    summary = classify(rows, peak, hbm, durations, steps=steps)
     # per-op-class rollup (the --diff tables join on these classes; also
     # the counting model behind bench.py's convert_bytes_pct)
     summary["by_class"] = class_totals(rows)
@@ -821,7 +878,9 @@ def main() -> None:
         "peak_flops": peak,
         "hbm_bytes_per_s": hbm,
         "config": {"batch": args.batch, "imsize": args.imsize,
-                   "num_stack": args.num_stack, "steps": args.steps,
+                   "num_stack": args.num_stack, "steps": steps,
+                   "mode": args.mode, "variant": args.variant,
+                   "width": args.hourglass_inch,
                    "remat": args.remat, "loss_kernel": args.loss_kernel,
                    "param_policy": args.param_policy,
                    "epilogue": args.epilogue, "amp": True},
@@ -837,7 +896,10 @@ def main() -> None:
                  "fusion choices — a proxy for the TPU compiler's"),
     }
 
-    if args.ab_loss_kernel:
+    if args.ab_loss_kernel and predict_mode:
+        log("--ab-loss-kernel is a train-mode A/B; ignoring in "
+            "--mode predict")
+    if args.ab_loss_kernel and not predict_mode:
         ab = {}
         for variant in ("xla", "fused"):
             c, _, _, _ = build_step(jax, args, variant)
@@ -880,9 +942,10 @@ def main() -> None:
     else:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         tag = ("_" + args.tag) if args.tag else ""
-        out_path = os.path.join(root, "artifacts", graft_round(),
-                                "roofline",
-                                "roofline_%s%s.json" % (platform, tag))
+        out_path = os.path.join(
+            root, "artifacts", graft_round(), "roofline",
+            "roofline_%s%s%s.json"
+            % (platform, "_predict" if predict_mode else "", tag))
     from real_time_helmet_detection_tpu.utils import (atomic_write_bytes,
                                                       save_json)
     os.makedirs(os.path.dirname(out_path), exist_ok=True)

@@ -81,7 +81,8 @@ SECTION_KEYS = {"inference": "inference_batch_sweep",
                 "train": "train_batch_sweep",
                 "stack2": "num_stack2", "remat": "remat",
                 "stack4_768": "stack4_768", "step_grid": "step_grid",
-                "int8": "int8_inference", "serve": "serve_buckets"}
+                "int8": "int8_inference", "serve": "serve_buckets",
+                "arch_grid": "arch_grid"}
 
 
 def merge_prior(results: dict, prior: dict, only: set) -> dict:
@@ -109,6 +110,8 @@ def merge_prior(results: dict, prior: dict, only: set) -> dict:
             if sec == "step_grid" and "step_grid_selected" in prior:
                 # the derived pick rides with its section
                 results["step_grid_selected"] = prior["step_grid_selected"]
+            if sec == "arch_grid" and "arch_grid_selected" in prior:
+                results["arch_grid_selected"] = prior["arch_grid_selected"]
     return results
 
 
@@ -166,7 +169,7 @@ def main() -> None:
         "dispatch_ms": round(overhead * 1e3, 3),
         "inference_batch_sweep": [], "train_batch_sweep": [],
         "num_stack2": {}, "remat": [], "stack4_768": [], "step_grid": [],
-        "int8_inference": [], "serve_buckets": [],
+        "int8_inference": [], "serve_buckets": [], "arch_grid": [],
     }
     def read_prior(path):
         """Prior results at `path`, or None if absent/unreadable — a kill
@@ -600,6 +603,174 @@ def main() -> None:
                     {"bucket": bucket,
                      "error": str(e).splitlines()[-1][:200]})
                 log("serve b=%d FAILED: %r" % (bucket, e))
+            flush()
+
+    # --- 9. architecture grid: variant x stacks x width (ISSUE 13) --------
+    # The outer loop of the latency-tier architecture search (Lighter
+    # Stacked Hourglass variants, arxiv 2107.13643, searched with the
+    # full-stack-search methodology of arxiv 2105.12842, PAPERS.md): each
+    # cell compiles the b1 SERVE-WIRE predict program at (variant, stacks,
+    # width) and scores it with the roofline counting model (analytic
+    # FLOPs + operand/result HBM bytes via parse_hlo/attribute —
+    # deterministic, CPU-valid) plus XLA cost analysis. `--arch-map`
+    # additionally trains a synthetic-fixture smoke model per cell and
+    # records its mAP (the chip twin runs this; the counting model alone
+    # already orders the tiers). The tier pick lands in
+    # `arch_grid_selected` — the committed record config.TIER_PRESETS is
+    # calibrated against. Per-cell flush + prior-cell resume, the int8
+    # section's discipline.
+    if want("arch_grid"):
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import roofline as _roofline
+        arch_map = "--arch-map" in sys.argv
+
+        def bench_arch(variant, stacks, width):
+            cfg = Config(num_stack=stacks, hourglass_inch=width,
+                         variant=variant,
+                         stem_width=min(128, width),  # tier geometry
+                         num_cls=2, topk=100,
+                         conf_th=0.0, nms_th=0.5, imsize=imsize)
+            model = build_model(cfg, dtype=jnp.bfloat16)
+            params, batch_stats = init_variables(model, jax.random.key(0),
+                                                 imsize)
+            variables = {"params": params, "batch_stats": batch_stats}
+            predict = make_predict_fn(model, cfg, normalize="imagenet")
+            images = jnp.zeros((1, imsize, imsize, 3), jnp.uint8)
+            with tracer.span("compile", section="arch_grid",
+                             variant=variant, stacks=stacks,
+                             width=width) as sp:
+                compiled = predict.lower(variables, images).compile()
+            rows = _roofline.attribute(
+                *_roofline.parse_hlo(compiled.as_text()))
+            by_class = _roofline.class_totals(rows)
+            rec = {"variant": variant, "num_stack": stacks, "width": width,
+                   "imsize": imsize, "batch": 1,
+                   "params_m": round(sum(
+                       x.size for x in jax.tree.leaves(params)) / 1e6, 4),
+                   "predict_bytes": round(sum(r["bytes"] for r in rows)),
+                   "conv_bytes": round(by_class["conv"]["bytes"]),
+                   "compile_s": round(sp.dur_s, 1)}
+            fl = flops_of(compiled)
+            if fl:
+                rec["predict_gflops"] = round(fl / 1e9, 3)
+            if arch_map:
+                rec.update(arch_cell_map(variant, stacks, width))
+            return rec
+
+        def arch_cell_map(variant, stacks, width):
+            """Smoke-scale fixture mAP for one cell: train a scaled-down
+            twin (width/8 off-chip — CPU cannot train real widths in
+            sweep time) on the shared synthetic fixture, eval held-out
+            mAP. The RANKING signal that joins the counting model; the
+            real-width per-tier mAP is quality_matrix --tiers' job."""
+            from real_time_helmet_detection_tpu.data import \
+                make_synthetic_voc
+            from real_time_helmet_detection_tpu.evaluate import evaluate
+            from real_time_helmet_detection_tpu.train import train
+            map_imsize = 256 if on_tpu else 64
+            map_width = width if on_tpu else max(8, width // 8)
+            n_train, n_test = (128, 32) if on_tpu else (16, 8)
+            epochs = 6 if on_tpu else 2
+            root = "/tmp/voc_arch_%d" % map_imsize
+            if not os.path.isdir(root):
+                make_synthetic_voc(root, num_train=n_train,
+                                   num_test=n_test,
+                                   imsize=(map_imsize, map_imsize),
+                                   max_objects=8, seed=42, style="scenes")
+            save = "/tmp/arch_map/%s_s%d_w%d" % (variant, stacks, width)
+            if os.path.isdir(save):
+                import shutil
+                shutil.rmtree(save)
+            cfg = Config(train_flag=True, data=root, save_path=save,
+                         variant=variant, num_stack=stacks,
+                         hourglass_inch=map_width,
+                         stem_width=min(128, map_width), num_cls=2,
+                         batch_size=4, amp=on_tpu, end_epoch=epochs,
+                         imsize=map_imsize,
+                         multiscale=[map_imsize, map_imsize, 64],
+                         keep_ckpt=1, ckpt_interval=epochs,
+                         num_workers=2, print_interval=10, summary=False)
+            train(cfg)
+            cks = [d for d in os.listdir(save)
+                   if d.startswith("check_point_")]
+            ckpt = os.path.join(save, max(
+                cks, key=lambda d: int(d.rsplit("_", 1)[1])))
+            m = evaluate(Config(
+                data=root, save_path=save, model_load=ckpt,
+                variant=variant, num_stack=stacks,
+                hourglass_inch=map_width,
+                stem_width=min(128, map_width), num_cls=2, batch_size=4,
+                imsize=map_imsize, topk=100, conf_th=0.01, nms="nms",
+                nms_th=0.5, num_workers=2))
+            return {"map": round(float(m["map"]), 4),
+                    "map_imsize": map_imsize, "map_width": map_width}
+
+        if on_tpu:
+            grid = [(v, s, w) for v in ("residual", "depthwise", "ghost")
+                    for s in (1, 2) for w in (64, 96, 128)]
+        else:
+            # CPU: the three tier archetypes plus enough neighbors to
+            # order the frontier, at compile-feasible cost
+            grid = ([(v, 1, w)
+                     for v in ("residual", "depthwise", "ghost")
+                     for w in (64, 96)]
+                    + [("residual", 2, 128)])
+        prior_cells = [r for r in (prior or {}).get("arch_grid", [])
+                       if "predict_bytes" in r]
+        for r in prior_cells:
+            if r not in results["arch_grid"]:
+                results["arch_grid"].append(r)
+        done = {(r.get("variant"), r.get("num_stack"), r.get("width"))
+                for r in results["arch_grid"] if "predict_bytes" in r}
+        for variant, stacks, width in grid:
+            if (variant, stacks, width) in done:
+                log("arch_grid %s/s%d/w%d already measured; skipping"
+                    % (variant, stacks, width))
+                continue
+            try:
+                rec = bench_arch(variant, stacks, width)
+                results["arch_grid"].append(rec)
+                log("arch_grid %s/s%d/w%d: %s"
+                    % (variant, stacks, width, rec))
+            except Exception as e:  # noqa: BLE001
+                results["arch_grid"].append(
+                    {"variant": variant, "num_stack": stacks,
+                     "width": width,
+                     "error": str(e).splitlines()[-1][:200]})
+                log("arch_grid %s/s%d/w%d FAILED: %r"
+                    % (variant, stacks, width, e))
+            hb.beat("arch_grid %s/s%d/w%d done" % (variant, stacks,
+                                                   width))
+            flush()
+        ok = [r for r in results["arch_grid"]
+              if "predict_gflops" in r and "predict_bytes" in r]
+        if ok:
+            import math
+
+            def ident(r):
+                keep = ("variant", "num_stack", "width", "predict_gflops",
+                        "predict_bytes", "map")
+                return {k: r[k] for k in keep if k in r}
+
+            by_flops = sorted(ok, key=lambda r: (r["predict_gflops"],
+                                                 r["predict_bytes"]))
+            edge, quality = by_flops[0], by_flops[-1]
+            inner = [r for r in ok
+                     if r is not edge and r is not quality] or ok
+            mid = math.sqrt(edge["predict_gflops"]
+                            * quality["predict_gflops"])
+            throughput = min(inner, key=lambda r: (
+                abs(math.log(r["predict_gflops"]) - math.log(mid)),
+                r["predict_bytes"]))
+            results["arch_grid_selected"] = {
+                "policy": "edge = min predict FLOPs; quality = max "
+                          "(the flagship cell); throughput = the "
+                          "geometric-mid FLOPs cell — fixture mAP "
+                          "(--arch-map / quality_matrix --tiers) "
+                          "refines ties",
+                "edge": ident(edge), "throughput": ident(throughput),
+                "quality": ident(quality)}
+            log("arch_grid selected: %s" % results["arch_grid_selected"])
             flush()
 
     flush()

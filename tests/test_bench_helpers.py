@@ -193,7 +193,8 @@ def test_sweep_section_keys_cover_all_result_lists():
     sweep = _load_sweep()
     assert set(sweep.SECTION_KEYS.values()) == {
         "inference_batch_sweep", "train_batch_sweep", "num_stack2", "remat",
-        "stack4_768", "step_grid", "int8_inference", "serve_buckets"}
+        "stack4_768", "step_grid", "int8_inference", "serve_buckets",
+        "arch_grid"}
 
 
 def test_find_last_tpu_result_carries_int8_fields(tmp_path):

@@ -134,6 +134,8 @@ def test_model_tree_identical_and_logits_allclose(act):
                                    rtol=1e-2, atol=2e-5)
 
 
+@pytest.mark.slow  # 12 s at r15 --durations: gradient-equality pin
+# (numerics hygiene, not robustness) — re-tiered (ISSUE 13 satellite)
 def test_train_step_grads_allclose_fp32():
     """value_and_grad of the production loss through both epilogues at
     fp32: the recompute backward must match XLA autodiff."""

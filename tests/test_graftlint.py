@@ -454,6 +454,10 @@ def test_repo_ast_layer_clean_vs_baseline():
             for f in d["new"])
 
 
+@pytest.mark.slow  # 88 s at r15 --durations (and growing with every
+# audited entry — the tier variants added four): the full trace audit
+# still gates every chip enqueue via scripts/graftlint.py itself; the
+# smoke tier keeps the AST layer + CLI selfcheck (ISSUE 13 satellite)
 def test_repo_trace_audit_clean_vs_baseline():
     """Every public entry point traces clean (fixed shapes, no f64, no
     callbacks, donation aliasable, deterministic retrace). Jaxpr-level

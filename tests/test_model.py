@@ -168,6 +168,8 @@ def test_deep_supervision_stacks_differ():
     assert not np.allclose(np.asarray(out[:, 0]), np.asarray(out[:, 1]))
 
 
+@pytest.mark.slow  # 11 s at r15 --durations: remat numerics pin —
+# re-tiered (ISSUE 13 satellite)
 def test_remat_matches_plain_forward_and_grads():
     """--remat recomputes stack activations in backward; outputs and
     gradients must be identical to the stored-activation model."""

@@ -92,6 +92,8 @@ def test_bf16_policy_state_dtypes():
                for x in jax.tree.leaves(state.batch_stats))
 
 
+@pytest.mark.slow  # 27 s at r15 --durations: bit-identity pin
+# (perf-hygiene, not robustness) — re-tiered (ISSUE 13 satellite)
 def test_fp32_policy_bit_identical_to_pre_pr():
     """Acceptance: --param-policy fp32 traces the exact pre-PR step — the
     scanned program's loss and updated params are BIT-identical to the
@@ -133,6 +135,8 @@ def test_fp32_policy_bit_identical_to_pre_pr():
                               np.asarray(y, np.float32))
 
 
+@pytest.mark.slow  # 12 s at r15 --durations — re-tiered with the
+# rest of the param-policy numerics pins (ISSUE 13 satellite)
 def test_bf16_policy_gradient_equality_documented_atol():
     """Param grads under the policy are the fp32 policy's grads modulo ONE
     bf16 rounding (the cast boundary moves, the cotangent path doesn't):
@@ -159,6 +163,8 @@ def test_bf16_policy_gradient_equality_documented_atol():
             rtol=2e-2, atol=2e-3)
 
 
+@pytest.mark.slow  # 18 s at r15 --durations — re-tiered
+# (ISSUE 13 satellite)
 def test_bf16_policy_master_tracks_fp32_params():
     """One full scanned step each way: the policy's fp32 MASTER matches
     the fp32 policy's params to the documented atol (1e-4 after one

@@ -37,6 +37,9 @@ def roofline_run(tmp_path_factory):
     return out, _run(out)
 
 
+@pytest.mark.slow  # 26 s setup at r15 --durations: the CPU e2e
+# artifact run is a tool CI guard, not a robustness acceptance test —
+# re-tiered to fit the 870 s tier-1 budget (ISSUE 13 satellite)
 def test_roofline_cpu_end_to_end_schema(roofline_run):
     out, proc = roofline_run
     assert out.exists()

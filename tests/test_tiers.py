@@ -1,0 +1,462 @@
+"""Latency-tier model family tests (ISSUE 13): Lighter-Hourglass variant
+mechanics (forward/grad, BN-fold + int8 + fused-epilogue compatibility per
+variant), tier presets, the `--distill` teacher-student step (fixed
+shapes, zero extra D2H, soft loss actually training), and the fleet's
+per-tenant tier routing (bit-identity per tier, zero recompiles beyond
+each tier's AOT bucket set).
+
+The reference has one model size and no tiers at all (its only size knob
+is the untested num_stack constructor arg, ref hourglass.py:198); the
+variant blocks follow Lighter Stacked Hourglass (arxiv 2107.13643).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from real_time_helmet_detection_tpu.config import (ARCHITECTURE_FIELDS,
+                                                   MODEL_VARIANTS,
+                                                   TIER_PRESETS, Config,
+                                                   apply_tier, tier_of)
+from real_time_helmet_detection_tpu.models import build_model
+from real_time_helmet_detection_tpu.models.hourglass import VARIANTS
+from real_time_helmet_detection_tpu.ops.quant import (
+    calibrate_scales, fold_batchnorm, make_quant_model,
+    synthetic_calibration_batches)
+from real_time_helmet_detection_tpu.train import (Distiller,
+                                                  init_variables,
+                                                  make_distiller,
+                                                  make_train_step_body)
+
+IMSIZE = 64  # the recursive hourglass pools H/4 four times: 64 is the
+# smallest size whose bottom level is still 1x1
+INCH = 8
+
+
+def _cfg(**kw):
+    # stem_width=INCH: the tier geometry (stem follows model width) —
+    # also what keeps these tiny models tiny (a default 128-wide stem
+    # would dominate every compile here)
+    base = dict(num_stack=1, hourglass_inch=INCH, stem_width=INCH,
+                num_cls=2, batch_size=2, imsize=IMSIZE, topk=16,
+                conf_th=0.0, nms_th=0.5)
+    base.update(kw)
+    return Config(**base)
+
+
+def _variables(model, seed=0):
+    params, batch_stats = init_variables(model, jax.random.key(seed),
+                                         IMSIZE)
+    return {"params": params, "batch_stats": batch_stats}
+
+
+@pytest.fixture(scope="module")
+def images():
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.standard_normal(
+        (2, IMSIZE, IMSIZE, 3)).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# vocabulary / presets
+
+
+def test_variant_vocabulary_one_source_of_truth():
+    # config.MODEL_VARIANTS (stdlib-only validation) and models.VARIANTS
+    # (the consumer) must never drift
+    assert VARIANTS == MODEL_VARIANTS
+    # variant is an architecture field: eval restores it from the
+    # snapshot exactly like num_stack (a depthwise checkpoint evaluated
+    # with the residual graph would fail the restore)
+    assert "variant" in ARCHITECTURE_FIELDS
+
+
+def test_tier_presets_resolve_and_validate():
+    edge = apply_tier(Config(tier="edge"))
+    # edge = the arch_grid counting-model floor (ghost; see TIER_PRESETS)
+    assert edge.variant == "ghost" and edge.hourglass_inch == 64
+    assert edge.serve_buckets == [1, 2, 4]
+    th = apply_tier(Config(tier="throughput"))
+    assert th.variant == "ghost" and th.infer_dtype == "int8"
+    q = apply_tier(Config(tier="quality"))
+    assert q.num_stack == 2 and q.nms == "soft-nms"
+    # tier WINS over an individually-passed arch flag (the --preset law)
+    assert apply_tier(Config(tier="edge",
+                             hourglass_inch=999)).hourglass_inch == 64
+    with pytest.raises(ValueError):
+        Config(tier="mega")
+    with pytest.raises(ValueError):
+        Config(variant="dense")
+    with pytest.raises(ValueError):
+        Config(distill_alpha=0.0)
+
+
+def test_tier_of_maps_archs_and_defaults_to_flagship():
+    assert tier_of(Config()) == "flagship"  # the historical bench config
+    for name in TIER_PRESETS:
+        assert tier_of(apply_tier(Config(tier=name))) == name
+    assert tier_of(Config(hourglass_inch=48)) == "custom"
+
+
+# ---------------------------------------------------------------------------
+# variant mechanics
+
+
+@pytest.mark.parametrize("variant", ["depthwise", "ghost"])
+def test_variant_forward_shape_grads_and_cheaper_params(variant, images):
+    cfg = _cfg(variant=variant)
+    model = build_model(cfg)
+    v = _variables(model)
+    out = jax.jit(lambda vv, im: model.apply(vv, im, train=False))(
+        v, images)
+    assert out.shape == (2, 1, IMSIZE // 4, IMSIZE // 4, 6)
+    assert bool(jnp.isfinite(out).all())
+
+    def loss(params):
+        o = model.apply({"params": params,
+                         "batch_stats": v["batch_stats"]}, images,
+                        train=False)
+        return jnp.sum(o ** 2)
+
+    grads = jax.jit(jax.grad(loss))(v["params"])
+    assert all(bool(jnp.isfinite(g).all())
+               for g in jax.tree.leaves(grads))
+    # the variants exist to be CHEAPER: strictly fewer params than the
+    # residual baseline at the same width/stacks
+    base = build_model(_cfg(variant="residual"))
+    nbase = sum(x.size for x in jax.tree.leaves(
+        _variables(base)["params"]))
+    nvar = sum(x.size for x in jax.tree.leaves(v["params"]))
+    assert nvar < nbase
+
+
+@pytest.mark.parametrize("variant", ["residual", "depthwise", "ghost"])
+def test_variant_bn_fold_matches_training_graph(variant, images):
+    """PR 5 compatibility per variant: every variant's BN tree keeps the
+    Conv_0+BatchNorm_0 sibling shape, so fold_batchnorm produces the
+    fold_bn=True twin's exact param tree and the folded predict matches
+    the training graph (the int8 prerequisite)."""
+    cfg = _cfg(variant=variant)
+    model = build_model(cfg)
+    v = _variables(model)
+    folded = fold_batchnorm(v["params"], v["batch_stats"])
+    fmodel = build_model(cfg, fold_bn=True)
+    out = jax.jit(lambda vv, im: model.apply(vv, im, train=False))(
+        v, images)
+    out_f = jax.jit(lambda p, im: fmodel.apply({"params": p}, im,
+                                               train=False))(folded,
+                                                             images)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_f),
+                               atol=1e-4)
+
+
+@pytest.mark.parametrize("variant", ["depthwise", "ghost"])
+def test_variant_int8_twin_runs_finite(variant, images):
+    """Grouped/depthwise convs through the int8 PTQ path (QuantConv with
+    feature_group_count): calibrate -> fold -> int8 forward, finite out."""
+    cfg = _cfg(variant=variant)
+    model = build_model(cfg)
+    v = _variables(model)
+    scales = calibrate_scales(
+        cfg, v, synthetic_calibration_batches(2, IMSIZE, n=1))
+    folded = fold_batchnorm(v["params"], v["batch_stats"])
+    qmodel = make_quant_model(cfg, mode="int8")
+    out = jax.jit(lambda p, s, im: qmodel.apply(
+        {"params": p, "quant": s}, im, train=False))(
+            folded, jax.tree.map(jnp.asarray, scales), images)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_variant_fused_epilogue_checkpoint_interchange(images):
+    """FusedBNAct eligibility per variant (PR 7 compatibility): the fused
+    twin's param tree is IDENTICAL to the xla one, and eval outputs agree
+    (the checkpoint-interchange contract, now for a variant block)."""
+    cfg_x = _cfg(variant="depthwise", epilogue="xla")
+    cfg_f = _cfg(variant="depthwise", epilogue="fused")
+    mx = build_model(cfg_x)
+    mf = build_model(cfg_f)
+    vx = _variables(mx)
+    vf = _variables(mf)
+    assert (jax.tree.structure(vx["params"])
+            == jax.tree.structure(vf["params"]))
+    out_x = jax.jit(lambda vv, im: mx.apply(vv, im, train=False))(
+        vx, images)
+    out_f = jax.jit(lambda vv, im: mf.apply(vv, im, train=False))(
+        vx, images)  # SAME checkpoint through the fused graph
+    np.testing.assert_allclose(np.asarray(out_x), np.asarray(out_f),
+                               atol=2e-5)
+
+
+def test_ghost_odd_width_fails_loudly():
+    cfg = _cfg(variant="ghost", hourglass_inch=7)
+    model = build_model(cfg)
+    with pytest.raises(ValueError, match="even channel width"):
+        init_variables(model, jax.random.key(0), IMSIZE)
+
+
+# ---------------------------------------------------------------------------
+# distillation
+
+
+@pytest.fixture(scope="module")
+def distill_parts():
+    from real_time_helmet_detection_tpu.data import synthetic_target_batch
+    from real_time_helmet_detection_tpu.optim import build_optimizer
+    from real_time_helmet_detection_tpu.train import create_train_state
+    tcfg = _cfg(variant="residual", num_stack=2)
+    tm = build_model(tcfg)
+    tv = _variables(tm, seed=1)
+    dist = Distiller(tm, tv["params"], tv["batch_stats"], alpha=0.5,
+                     num_cls=2, normalized_coord=False)
+    scfg = _cfg(variant="depthwise")
+    sm = build_model(scfg)
+    tx = build_optimizer(scfg, 10)
+    state = create_train_state(sm, scfg, jax.random.key(0), IMSIZE, tx)
+    arrs = tuple(jnp.asarray(a) for a in synthetic_target_batch(
+        2, IMSIZE, pos_rate=0.05))
+    return scfg, sm, tx, state, arrs, dist
+
+
+def test_distill_step_fixed_shape_and_rides_the_one_fetch(distill_parts):
+    """The soft-loss scalars are FIXED-SHAPE () entries of the SAME
+    losses dict every other component rides (train_epoch fetches pending
+    in ONE device_get per flush window — extra keys are extra scalars on
+    that fetch, zero extra D2H), and the hard components are untouched
+    by the teacher (same forward, same targets)."""
+    scfg, sm, tx, state, arrs, dist = distill_parts
+    body_d = make_train_step_body(sm, tx, scfg, distill=dist)
+    body_p = make_train_step_body(sm, tx, scfg)
+    _, losses_d = jax.jit(body_d)(state, *arrs)
+    _, losses_p = jax.jit(body_p)(state, *arrs)
+    assert "distill" in losses_d and "distill" not in losses_p
+    assert all(v.shape == () for v in losses_d.values())
+    for k in ("hm", "offset", "size"):
+        assert float(losses_d[k]) == float(losses_p[k])
+    np.testing.assert_allclose(
+        float(losses_d["total"]),
+        float(losses_p["total"]) + 0.5 * float(losses_d["distill"]),
+        rtol=1e-6)
+
+
+def test_distill_soft_loss_decreases_over_steps(distill_parts):
+    """The soft targets actually TRAIN: a few optimizer steps on a fixed
+    batch reduce the distill loss (the student moves toward the
+    teacher), and every loss stays finite."""
+    scfg, sm, tx, state, arrs, dist = distill_parts
+    step = jax.jit(make_train_step_body(sm, tx, scfg, distill=dist))
+    st = state
+    vals = []
+    for _ in range(6):
+        st, losses = step(st, *arrs)
+        vals.append(float(losses["distill"]))
+    assert all(np.isfinite(v) for v in vals)
+    assert vals[-1] < vals[0]
+
+
+def test_make_distiller_restores_teacher_architecture(tmp_path):
+    """--distill restores the TEACHER's graph from the checkpoint dir's
+    argument.json snapshot: a stack2 residual teacher distills into a
+    depthwise student without teacher flags on the student CLI."""
+    from real_time_helmet_detection_tpu.config import save_config
+    from real_time_helmet_detection_tpu.ops.loss import LossLog
+    from real_time_helmet_detection_tpu.optim import build_optimizer
+    from real_time_helmet_detection_tpu.train import (create_train_state,
+                                                      save_checkpoint)
+    tcfg = _cfg(variant="residual", num_stack=2, train_flag=True,
+                save_path=str(tmp_path))
+    tm = build_model(tcfg)
+    tx = build_optimizer(tcfg, 10)
+    tstate = create_train_state(tm, tcfg, jax.random.key(1), IMSIZE, tx)
+    save_checkpoint(str(tmp_path), 0, tstate, LossLog())
+    save_config(tcfg, str(tmp_path))
+    scfg = _cfg(variant="depthwise", distill=str(tmp_path),
+                distill_alpha=0.25, imsize=IMSIZE)
+    dist = make_distiller(scfg)
+    assert dist is not None and dist.alpha == 0.25
+    assert dist.model.num_stack == 2
+    assert dist.model.variant == "residual"
+    # and distill unset -> no teacher, the pre-PR path
+    assert make_distiller(_cfg()) is None
+
+
+# ---------------------------------------------------------------------------
+# fleet tier routing
+
+
+def test_fleet_tier_routing_bit_identity_zero_recompiles():
+    """The ROADMAP interplay: bulk tenants route to the edge tier,
+    flagged tenants to the quality tier; every result is bit-identical
+    to one-shot predict on THAT tier's model, with zero recompiles
+    beyond each tier's AOT bucket set; tier routing is strict (an
+    unknown tier raises; a tenant_tiers policy naming a slotless tier
+    fails construction)."""
+    from real_time_helmet_detection_tpu.obs.metrics import MetricsRegistry
+    from real_time_helmet_detection_tpu.obs.telemetry import \
+        install_recompile_counter
+    from real_time_helmet_detection_tpu.predict import make_predict_fn
+    from real_time_helmet_detection_tpu.serving import (FleetRouter,
+                                                        ServingEngine)
+
+    tiers = {}
+    for name, variant, stacks in (("edge", "depthwise", 1),
+                                  ("quality", "residual", 2)):
+        cfg = _cfg(variant=variant, num_stack=stacks)
+        model = build_model(cfg)
+        v = _variables(model, seed=3)
+        predict = make_predict_fn(model, cfg, normalize="imagenet")
+        tiers[name] = (predict, v)
+    rng = np.random.default_rng(7)
+    pool = [rng.integers(0, 256, (IMSIZE, IMSIZE, 3), dtype=np.uint8)
+            for _ in range(4)]
+
+    def oracle(name):
+        predict, v = tiers[name]
+        return [jax.tree.map(lambda le: np.asarray(le[0]),
+                             jax.device_get(predict(v, img[None])))
+                for img in pool]
+
+    oracles = {name: oracle(name) for name in tiers}
+    slot_tiers = ["edge", "quality"]
+
+    def factory(rid, start=True):
+        predict, v = tiers[slot_tiers[rid]]
+        return ServingEngine(predict, v, (IMSIZE, IMSIZE, 3), np.uint8,
+                             buckets=(1, 2), max_wait_ms=1.0, depth=2,
+                             queue_capacity=32,
+                             metrics=MetricsRegistry(), start=start)
+
+    with pytest.raises(ValueError, match="no replica slot"):
+        FleetRouter(factory, 2, replica_tiers=slot_tiers,
+                    tenant_tiers={"bulk": "mega"},
+                    metrics=MetricsRegistry()).close()
+
+    router = FleetRouter(factory, 2, replica_tiers=slot_tiers,
+                         tenant_tiers={"bulk": "edge",
+                                       "flagged": "quality"},
+                         metrics=MetricsRegistry())
+    try:
+        # warm both tiers' dispatch paths, then pin zero recompiles
+        router.predict_many(pool[:1], tenant="bulk")
+        router.predict_many(pool[:1], tenant="flagged")
+        counter = install_recompile_counter()
+        futs = []
+        for i, img in enumerate(pool):
+            futs.append(("edge", i, router.submit(img, tenant="bulk")))
+            futs.append(("quality", i,
+                         router.submit(img, tenant="flagged")))
+        for name, i, f in futs:
+            got = f.result(timeout=60)
+            want = oracles[name][i]
+            for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+                assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert counter.count == 0
+        # routing stayed inside the tier's slot
+        for name, _, f in futs:
+            rid = slot_tiers.index(name)
+            assert all(r == rid for r in f.replicas)
+        # strict: an unknown per-submit tier raises
+        with pytest.raises(ValueError, match="unknown tier"):
+            router.submit(pool[0], tier="mega")
+        h = router.health()
+        assert [r["tier"] for r in h["replicas"]] == slot_tiers
+        assert h["tenant_tiers"] == {"bulk": "edge",
+                                     "flagged": "quality"}
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# bench arch fields
+
+
+def test_bench_arch_of_pre_tier_lines_parse_as_flagship():
+    import bench
+    assert bench.bench_arch_of({}) == {
+        "variant": "residual", "num_stack": 1, "width": 128,
+        "tier": "flagship"}
+    line = {"variant": "depthwise", "num_stack": 1, "width": 64,
+            "tier": "edge"}
+    assert bench.bench_arch_of(line) == line
+    # partial lines (old fields only) fill flagship defaults
+    assert bench.bench_arch_of({"num_stack": 2})["variant"] == "residual"
+
+
+def test_find_last_tpu_result_carries_arch_fields(tmp_path):
+    """ISSUE 13 satellite: the arch fields survive find_last_tpu_result
+    and pre-tier lines keep reading (no arch keys -> consumer defaults
+    via bench_arch_of)."""
+    import bench
+    root = str(tmp_path)
+    d = os.path.join(root, "artifacts", "r15")
+    os.makedirs(d)
+    rec = {"platform": "tpu", "metric": "inference_fps_512",
+           "value": 900.0, "variant": "depthwise", "num_stack": 1,
+           "width": 64, "tier": "edge"}
+    with open(os.path.join(d, "BENCH_r15_local.json"), "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    got = bench.find_last_tpu_result(root)
+    assert got["variant"] == "depthwise" and got["tier"] == "edge"
+    assert got["width"] == 64
+    arch = bench.bench_arch_of(got)
+    assert arch["variant"] == "depthwise"
+
+
+def test_perfgate_bench_sig_forks_on_arch():
+    """A tier bench line must never gate against the flagship trajectory
+    (and pre-tier lines keep their historical keys)."""
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "perfgate", os.path.join(repo, "scripts", "perfgate.py"))
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+    old = {"platform": "tpu", "imsize": 512, "batch": 16}
+    new_flag = dict(old, variant="residual", num_stack=1, width=128,
+                    tier="flagship")
+    edge = dict(old, variant="depthwise", num_stack=1, width=64,
+                tier="edge")
+    assert pg._bench_sig(old) == pg._bench_sig(new_flag)
+    assert pg._bench_sig(edge) != pg._bench_sig(old)
+
+
+def test_distill_cfg_roundtrips_config_snapshot(tmp_path):
+    """--distill/--tier/--variant ride the argument.json snapshot like
+    every other flag (load_config ignores unknown keys on old
+    snapshots)."""
+    from real_time_helmet_detection_tpu.config import (load_config,
+                                                       save_config)
+    cfg = _cfg(variant="ghost", distill="/x/teacher", distill_alpha=0.7)
+    save_config(cfg, str(tmp_path))
+    back = load_config(os.path.join(str(tmp_path), "argument.json"))
+    assert back.variant == "ghost"
+    assert back.distill == "/x/teacher"
+    assert back.distill_alpha == 0.7
+    # pre-tier snapshot (no variant key) -> default
+    with open(os.path.join(str(tmp_path), "old.json"), "w") as f:
+        json.dump({"num_stack": 2}, f)
+    assert load_config(
+        os.path.join(str(tmp_path), "old.json")).variant == "residual"
+
+
+def test_sweep_arch_grid_selected_carries_with_section():
+    """merge_prior keeps arch_grid_selected glued to its section (the
+    step_grid_selected rule, ISSUE 13 twin)."""
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "tpu_sweep", os.path.join(repo, "scripts", "tpu_sweep.py"))
+    sweep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sweep)
+    prior = {"platform": "cpu",
+             "arch_grid": [{"variant": "depthwise", "num_stack": 1,
+                            "width": 64, "predict_bytes": 1}],
+             "arch_grid_selected": {"edge": {"variant": "depthwise"}}}
+    results = {"platform": "cpu", "arch_grid": []}
+    out = sweep.merge_prior(results, prior, only={"int8"})
+    assert out["arch_grid_selected"] == prior["arch_grid_selected"]
+    assert out["arch_grid"] == prior["arch_grid"]

@@ -389,6 +389,8 @@ def test_bf16_policy_step_runs():
     assert np.isfinite(float(losses["total"]))
 
 
+@pytest.mark.slow  # 21 s at r15 --durations: scan-vs-sequential
+# equivalence (perf-harness hygiene) — re-tiered (ISSUE 13 satellite)
 def test_scanned_train_fn_matches_sequential_steps():
     """The bench/scaling timing harness (`make_scanned_train_fn`) must run
     the EXACT production step: N scanned steps == N sequential
@@ -420,6 +422,9 @@ def test_scanned_train_fn_matches_sequential_steps():
         rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow  # 15 s at r15 --durations: donation-warning pin
+# (the trace-audit donation rule covers the aval law in-tier) —
+# re-tiered (ISSUE 13 satellite)
 def test_scanned_train_fn_donation_emits_no_warning():
     """The timing harness donates its state (the production memory regime,
     bench.py/scaling.py) and returns the final state so every donated
@@ -601,6 +606,9 @@ def _grads_of(cfg, batch):
 
 
 @pytest.mark.parametrize("mode", ["stacks", "full"])
+@pytest.mark.slow  # 13+10 s at r15 --durations: gradient-equality
+# pins (numerics hygiene; test_model's remat pin stays smoke via the
+# full-suite slow tier) — re-tiered (ISSUE 13 satellite)
 def test_remat_gradient_equality_vs_none(mode):
     """--remat {stacks,full} recompute activations in backward; loss and
     gradients must match --remat none semantically (recompute reassociates
@@ -616,6 +624,8 @@ def test_remat_gradient_equality_vs_none(mode):
                                atol=scale * 1e-5, rtol=1e-4)
 
 
+@pytest.mark.slow  # 9 s at r15 --durations — re-tiered with its
+# single-device twin (ISSUE 13 satellite)
 def test_remat_gradient_equality_on_mesh():
     """--remat stacks vs none through the PRODUCTION sharded train step on
     the virtual 8-device mesh (the ISSUE-2 acceptance pairing): one step
