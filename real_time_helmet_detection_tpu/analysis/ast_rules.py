@@ -87,6 +87,19 @@ analogue is manual code review, ref /root/reference/README.md:1):
                           single-engine surfaces (evaluate/demo/export-
                           style uses in modules that also drive the
                           fleet) are allowlisted.
+* `context-free-span`   — span/record/event emission of a request-path
+                          name (`serve:*`, `fleet:*`, `recover:*`)
+                          inside the serving package without a
+                          trace-context argument (`ctx=`/`links=`):
+                          an untraced request-path record is invisible
+                          to the waterfall assembler (obs/traceview.py)
+                          — the request it belongs to reads as having
+                          skipped that stage, and orphan/broken-chain
+                          detection silently weakens. Module-scope /
+                          process-lifecycle spans (compile, state
+                          transitions, rollout arcs — the
+                          TRACE_LIFECYCLE_SPANS allowlist) carry no
+                          per-request causality and are exempt.
 * `unbounded-retry`     — a `while True` retry loop whose except handler
                           swallows the failure and loops again with no
                           attempt cap and no backoff: the r2 probe-kill
@@ -176,6 +189,20 @@ RAW_WRITE_ALLOW = {
     # the atomic-write implementation itself
     "real_time_helmet_detection_tpu/utils.py",
 }
+# request-path span names that are NOT per-request (ISSUE 14): module
+# scope / process lifecycle — construction-time compiles, state-machine
+# transitions, whole-replica arcs, rollout control flow. Everything else
+# under the serve:/fleet:/recover: prefixes belongs to ONE request (or a
+# batch of them) and must carry ctx= or links=.
+TRACE_LIFECYCLE_SPANS = {
+    "serve:compile", "serve:state", "serve:killed", "serve:degrade",
+    "recover:reload",
+    "fleet:rollout", "fleet:promote", "fleet:rollback",
+    "fleet:replica-death", "fleet:respawn", "fleet:reload-timeout",
+    "fleet:tenant-shed",
+}
+_TRACED_SPAN_PREFIXES = ("serve:", "fleet:", "recover:")
+_TRACER_EMIT_FNS = {"span", "record", "event"}
 RAW_SPAN_ALLOW = {
     # the sanctioned timing harness (bench.py module docstring): its
     # wall-clock arithmetic IS the documented methodology — scan inside
@@ -552,6 +579,51 @@ def rule_device_get_in_serving_loop(tree, lines, relpath) -> List[Finding]:
     return out
 
 
+def rule_context_free_span(tree, lines, relpath) -> List[Finding]:
+    """Trace-context hygiene in the serving package (ISSUE 14): a
+    tracer span/record/event call whose name literal is a request-path
+    span (`serve:*`/`fleet:*`/`recover:*`) must carry `ctx=` (its
+    request's TraceContext) or `links=` (a batch's fan-in edges) —
+    module-scope/process-lifecycle spans (TRACE_LIFECYCLE_SPANS) are
+    exempt. Scope: serving/ modules, where every such record belongs to
+    an acknowledged request whose causal chain the fleet acceptance
+    gates reassemble."""
+    if not relpath.startswith(SERVING_PREFIX):
+        return []
+    out = []
+    for qual, node, body in _iter_scopes(tree):
+        for call in _scope_calls(body):
+            name = _call_name(call)
+            parts = name.split(".")
+            if parts[-1] not in _TRACER_EMIT_FNS or len(parts) < 2 \
+                    or "tracer" not in parts[-2].lower():
+                continue
+            first = call.args[0] if call.args else None
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                    and first.value.startswith(_TRACED_SPAN_PREFIXES)):
+                continue
+            if first.value in TRACE_LIFECYCLE_SPANS:
+                continue
+            if any(kw.arg in ("ctx", "links") for kw in call.keywords):
+                continue
+            if _suppressed("context-free-span", lines, call.lineno,
+                           getattr(call, "end_lineno", call.lineno)):
+                continue
+            out.append(Finding(
+                rule="ast/context-free-span", path=relpath,
+                line=call.lineno, context=qual,
+                message="request-path span %r emitted without a trace "
+                        "context (ctx=) or fan-in links (links=): the "
+                        "record is invisible to the waterfall assembler "
+                        "and the request's causal chain silently loses "
+                        "this stage — thread the request's TraceContext "
+                        "through (obs/trace.py), or add the name to "
+                        "TRACE_LIFECYCLE_SPANS if it is genuinely "
+                        "process-lifecycle" % first.value))
+    return out
+
+
 def _references_fleet_router(tree: ast.Module) -> bool:
     for node in ast.walk(tree):
         if isinstance(node, ast.Name) and node.id == "FleetRouter":
@@ -816,7 +888,7 @@ RULES = (rule_per_call_timing, rule_queue_bypass, rule_env_platform_write,
          rule_missing_ref_citation, rule_raw_span_timing,
          rule_device_get_in_serving_loop, rule_unbounded_retry,
          rule_raw_metric_aggregation, rule_unbarriered_collective_start,
-         rule_engine_bypass_in_fleet)
+         rule_engine_bypass_in_fleet, rule_context_free_span)
 
 
 # ---------------------------------------------------------------------------

@@ -15,6 +15,10 @@ all — its loop prints averaged meters, ref train.py:140-160):
 * `obs.slo` (stdlib): the SLO watchdog — EWMA/z-score drift + error/
   latency budget burn rules emitting `alert:*` events and degrading the
   serving engine.
+* `obs.trace` (stdlib): trace contexts (ISSUE 14) — per-request
+  causality minted at the fleet/engine front door, serialized as
+  optional obs-spans-v1 fields; `obs.traceview` reassembles waterfalls
+  + critical paths and flags orphan/broken chains.
 
 This __init__ stays STDLIB-ONLY (spans/context/metrics/slo re-exports):
 runtime/ — which must never build the ML stack — imports `obs.spans` for
@@ -34,3 +38,5 @@ from .slo import (DriftDetector, DriftRule, ErrorBurnRule,  # noqa: F401
                   default_train_rules)
 from .spans import (OBS_SPAN_ENV, SPAN_SCHEMA, Span,  # noqa: F401
                     SpanTracer, maybe_tracer, read_spans)
+from .trace import (TraceContext, links_of, new_root,  # noqa: F401
+                    reset_ids, step_context)

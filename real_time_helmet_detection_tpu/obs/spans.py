@@ -30,6 +30,19 @@ Span taxonomy (docs/ARCHITECTURE.md "Observability & flight recorder"):
 `calibrate`, `bench:*` section spans, `heartbeat` events (the runtime
 heartbeat mirrors every beat here when tracing is on), `recompile` events
 and `context` records (loadavg + relay liveness).
+
+Trace-context extension (ISSUE 14, obs/trace.py): every write method
+takes an optional `ctx` (a TraceContext — serialized as the optional
+`trace`/`span`/`parent` record fields) and `links` (fan-in edges: a
+batch span names every member request's context). Span records written
+with either also carry `t0`, the wall-clock START of the measured
+interval (`t` alone is ambiguous across the two write paths: a span CM
+stamps construction, `record()` stamps the write — the waterfall
+assembler needs the interval, not a point). `bind(**tags)` attaches
+process-constant fields (rank, world) to every subsequent record — the
+cross-process join key for train/scaling rank logs. All fields are
+OPTIONAL additions to obs-spans-v1: readers of pre-ISSUE logs see
+nothing new, pre-ISSUE readers of new logs ignore the extras.
 """
 
 from __future__ import annotations
@@ -64,11 +77,14 @@ class Span:
 class _SpanCM:
     """Context manager wrapping one Span; writes the record on exit."""
 
-    __slots__ = ("_tracer", "_span")
+    __slots__ = ("_tracer", "_span", "_ctx", "_links")
 
-    def __init__(self, tracer: "SpanTracer", span: Span):
+    def __init__(self, tracer: "SpanTracer", span: Span, ctx=None,
+                 links=None):
         self._tracer = tracer
         self._span = span
+        self._ctx = ctx
+        self._links = links
 
     def __enter__(self) -> Span:
         return self._span
@@ -79,9 +95,27 @@ class _SpanCM:
         meta = dict(sp.meta)
         if exc_type is not None:
             meta["error"] = exc_type.__name__
-        self._tracer._write({"kind": "span", "name": sp.name,
-                             "t": sp.t_wall, "dur_s": round(sp.dur_s, 6),
-                             **({"meta": meta} if meta else {})})
+        rec = {"kind": "span", "name": sp.name,
+               "t": sp.t_wall, "dur_s": round(sp.dur_s, 6),
+               **({"meta": meta} if meta else {})}
+        _trace_fields(rec, self._ctx, self._links, t0=sp.t_wall)
+        self._tracer._write(rec)
+
+
+def _trace_fields(rec: dict, ctx, links, t0: Optional[float] = None
+                  ) -> None:
+    """Fold optional trace-context fields into a record in place (ISSUE
+    14). `t0` (interval start) rides along whenever the record is part of
+    a trace — the waterfall assembler needs intervals, not points."""
+    traced = False
+    if ctx is not None:
+        rec.update(ctx.to_fields())
+        traced = True
+    if links:
+        rec["links"] = list(links)
+        traced = True
+    if traced and t0 is not None:
+        rec["t0"] = t0
 
 
 class SpanTracer:
@@ -95,6 +129,7 @@ class SpanTracer:
         self.path = path or None
         self._f = None
         self.enabled = self.path is not None
+        self._bound: dict = {}
 
     # ---- the write path --------------------------------------------------
 
@@ -115,6 +150,8 @@ class SpanTracer:
                          "t": time.time()}, sort_keys=True) + "\n")
             rec.setdefault("v", 1)
             rec.setdefault("pid", os.getpid())
+            for k, v in self._bound.items():
+                rec.setdefault(k, v)
             self._f.write(json.dumps(rec, sort_keys=True) + "\n")
             self._f.flush()
         except (OSError, ValueError, TypeError):
@@ -124,23 +161,38 @@ class SpanTracer:
 
     # ---- public API ------------------------------------------------------
 
-    def span(self, name: str, **meta) -> _SpanCM:
+    def bind(self, **tags) -> None:
+        """Attach process-constant fields (rank, world) to every record
+        this tracer writes from now on — the cross-process join key for
+        per-rank span logs (ISSUE 14)."""
+        self._bound.update(tags)
+
+    def span(self, name: str, ctx=None, links=None, **meta) -> _SpanCM:
         """`with tracer.span("compile", batch=16) as sp: ...` — times the
         block (always), writes a span record on exit (when enabled), and
-        leaves the duration readable as `sp.dur_s`."""
-        return _SpanCM(self, Span(name, meta))
+        leaves the duration readable as `sp.dur_s`. `ctx`/`links` attach
+        the span to a trace (obs/trace.py)."""
+        return _SpanCM(self, Span(name, meta), ctx=ctx, links=links)
 
-    def record(self, name: str, dur_s: float, **meta) -> None:
+    def record(self, name: str, dur_s: float, ctx=None, links=None,
+               **meta) -> None:
         """A span whose duration the caller already measured (the train/
-        eval segment meters): write it without re-timing."""
-        self._write({"kind": "span", "name": name, "t": time.time(),
-                     "dur_s": round(float(dur_s), 6),
-                     **({"meta": meta} if meta else {})})
+        eval segment meters): write it without re-timing. The write stamp
+        is the interval END; a traced record carries `t0 = t - dur_s` so
+        the waterfall assembler sees the interval."""
+        t = time.time()
+        rec = {"kind": "span", "name": name, "t": t,
+               "dur_s": round(float(dur_s), 6),
+               **({"meta": meta} if meta else {})}
+        _trace_fields(rec, ctx, links, t0=t - float(dur_s))
+        self._write(rec)
 
-    def event(self, name: str, **meta) -> None:
+    def event(self, name: str, ctx=None, links=None, **meta) -> None:
         """Zero-duration marker (heartbeat, recompile, job transition)."""
-        self._write({"kind": "event", "name": name, "t": time.time(),
-                     **({"meta": meta} if meta else {})})
+        rec = {"kind": "event", "name": name, "t": time.time(),
+               **({"meta": meta} if meta else {})}
+        _trace_fields(rec, ctx, links)
+        self._write(rec)
 
     def context(self, **extra) -> Optional[dict]:
         """Sample host context (loadavg, relay liveness — obs/context.py)

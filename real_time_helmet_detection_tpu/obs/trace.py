@@ -1,0 +1,163 @@
+"""Trace contexts: Dapper-style request causality for the flight recorder.
+
+The reference has no observability tooling of any kind (its loop prints
+averaged meters, ref train.py:140-160); this module is new capability
+(ISSUE 14). The repo's span logs (obs/spans.py) and metrics plane
+(obs/metrics.py) answer AGGREGATE questions — p99 exists, batches failed
+— but nothing could reconstruct *why one request* was slow across a
+router hop -> replica retry -> bucket wait -> AOT execute chain, and
+multi-process training ranks write disjoint span logs with no causality
+join. A `TraceContext` is the join key: minted once per request at the
+fleet front door (or by the engine for standalone serving), carried
+through every hop, and serialized into the existing `obs-spans-v1` JSONL
+lines as OPTIONAL fields (`trace`/`span`/`parent`/`links`) so pre-ISSUE
+logs stay readable byte-for-byte.
+
+Design rules, each load-bearing:
+
+* **stdlib only.** Imported by `obs.spans` consumers including
+  `runtime/` paths that must never build the ML stack.
+* **Deterministic ids, no wall-clock coupling.** Ids come from a seeded
+  per-process counter under a per-process prefix (pid by default,
+  `reset_ids(seed)` for tests and replay) — the same traffic replayed
+  through the same code mints the same ids, and nothing here reads
+  `time.time()` (the PR 10 no-wall-clock rule: determinism is what makes
+  chaos replays and selfchecks assertable).
+* **Fan-in is links, not parent edges.** A serving batch span serves N
+  requests at once; it carries `links=[{trace, span}, ...]` naming every
+  member request's context instead of one parent — the analyzer
+  (obs/traceview.py) attaches the batch stages to each member's
+  waterfall, so one slow compute explains N tails.
+* **Closure is owned by the root minter.** Whoever mints a root context
+  (router, or engine when standalone) emits the ONE root-closure record
+  (a span with no parent — `fleet:e2e` / `serve:e2e` / a terminal shed
+  or failure event); everything downstream emits child contexts. A trace
+  with children but no closure is an ORPHAN — a hard error the analyzer
+  flags, never a tolerated ambiguity.
+
+Cross-process joins (train/scaling ranks): `step_context(step, epoch,
+rank, run)` derives the trace id from (run, epoch, step) alone — every
+rank of the same step mints the SAME trace id with a rank-scoped span
+id, so N per-rank span logs assemble into one per-step trace with zero
+coordination traffic.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+# the optional obs-spans-v1 record fields this layer owns
+TRACE_FIELDS = ("trace", "span", "parent", "links")
+
+
+class _IdGen:
+    """Per-process id mint: `<prefix>-<counter>`. The prefix defaults to
+    the pid (unique across the ranks/replica processes whose logs get
+    joined on one host); `reset(seed)` pins it for tests/replay."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._prefix = "%x" % os.getpid()
+
+    def reset(self, seed: Optional[int] = None) -> None:
+        with self._lock:
+            self._n = 0
+            self._prefix = ("%x" % os.getpid() if seed is None
+                            else "s%x" % int(seed))
+
+    def next_id(self) -> str:
+        with self._lock:
+            self._n += 1
+            return "%s-%x" % (self._prefix, self._n)
+
+
+_IDS = _IdGen()
+
+
+def reset_ids(seed: Optional[int] = None) -> None:
+    """Re-seed the per-process id mint (tests/replay). `None` restores
+    the pid-derived production prefix."""
+    _IDS.reset(seed)
+
+
+class TraceContext:
+    """One node of a request's causal chain: (trace_id, span_id,
+    parent_id). Immutable by convention — propagation mints children,
+    never mutates."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str] = None):
+        self.trace_id = str(trace_id)
+        self.span_id = str(span_id)
+        self.parent_id = None if parent_id is None else str(parent_id)
+
+    @property
+    def is_root(self) -> bool:
+        return self.parent_id is None
+
+    def child(self) -> "TraceContext":
+        """A fresh span under this one (same trace, parent = this span)."""
+        return TraceContext(self.trace_id, _IDS.next_id(), self.span_id)
+
+    def link(self) -> Dict[str, str]:
+        """The fan-in edge form: what a batch span's `links` list holds."""
+        return {"trace": self.trace_id, "span": self.span_id}
+
+    def to_fields(self) -> Dict[str, str]:
+        """The optional obs-spans-v1 record fields (parent omitted at the
+        root, so root-closure records are recognizable by its absence)."""
+        out = {"trace": self.trace_id, "span": self.span_id}
+        if self.parent_id is not None:
+            out["parent"] = self.parent_id
+        return out
+
+    @classmethod
+    def from_fields(cls, rec: Dict) -> Optional["TraceContext"]:
+        """Rebuild from a span-log record (None when the record carries
+        no trace fields — every pre-ISSUE record)."""
+        if not isinstance(rec, dict) or "trace" not in rec:
+            return None
+        span = rec.get("span")
+        if span is None:
+            return None
+        return cls(rec["trace"], span, rec.get("parent"))
+
+    def __repr__(self) -> str:
+        return "TraceContext(%s, %s, parent=%s)" % (
+            self.trace_id, self.span_id, self.parent_id)
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, TraceContext)
+                and self.trace_id == other.trace_id
+                and self.span_id == other.span_id
+                and self.parent_id == other.parent_id)
+
+
+def new_root() -> TraceContext:
+    """Mint a request root (the FleetRouter.submit / standalone
+    ServingEngine.submit entry point)."""
+    t = _IDS.next_id()
+    return TraceContext(t, _IDS.next_id(), None)
+
+
+def step_context(step: int, epoch: int = 0, rank: int = 0,
+                 run: Optional[str] = None) -> TraceContext:
+    """The cross-process per-step context: trace id derived from
+    (run, epoch, step) ONLY — every rank mints the same trace id with a
+    rank-scoped span id, so per-rank span logs join into one per-step
+    trace with no coordination. `run` defaults to $OBS_TRACE_RUN (the
+    launcher exports one tag per run) else "train"."""
+    run = run or os.environ.get("OBS_TRACE_RUN") or "train"
+    trace_id = "step-%s-e%d-i%06d" % (run, int(epoch), int(step))
+    return TraceContext(trace_id, "%s.r%d" % (trace_id, int(rank)), None)
+
+
+def links_of(contexts: List[Optional[TraceContext]]) -> List[Dict]:
+    """Fan-in link list over a batch's member contexts (Nones — untraced
+    members — dropped; an empty result means the batch is untraced)."""
+    return [c.link() for c in contexts if c is not None]

@@ -84,6 +84,22 @@ Design rules, each load-bearing:
   (the fetch — where un-hidden device time surfaces, exactly like
   eval's `fetch` span) / `serve:e2e` per request; `$OBS_SPAN_LOG` is
   honored via `obs.spans.maybe_tracer`.
+* **Trace contexts (ISSUE 14).** With tracing enabled, every request
+  carries a `TraceContext` (obs/trace.py): `submit(ctx=...)` accepts
+  one from the FleetRouter, else the engine mints a root itself.
+  Per-request spans (`serve:queue-wait`/`serve:e2e`/`serve:shed`)
+  carry the context; batch-level spans (`serve:batch-form`/`h2d`/
+  `compute`/`d2h`) and the `recover:*` events carry fan-in `links`
+  naming every member request's context — one slow compute explains N
+  tails (obs/traceview.py reassembles the waterfalls). CLOSURE
+  OWNERSHIP: the root minter accounts for the request's end — when the
+  engine minted the root it emits the root-closure `serve:e2e` (or a
+  terminal `serve:failed`/`serve:shed`); under a router-minted root
+  everything engine-side is a child and the router closes. Tracing OFF
+  (the production default without $OBS_SPAN_LOG) threads `None`
+  everywhere: the executed programs, the single per-batch D2H and the
+  device_get count are IDENTICAL on or off (pinned by
+  tests/test_trace.py) — contexts are host-side bookkeeping only.
 * **Live metrics plane (ISSUE 10).** Every admission decision, batch
   outcome and pipeline stage also lands in an `obs.metrics` registry:
   `serve.*` counters (submitted/completed/shed/retried/requeued/
@@ -108,6 +124,8 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..obs.trace import links_of, new_root
 
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16)
 
@@ -169,7 +187,7 @@ class ServeFuture:
     fetcher)."""
 
     __slots__ = ("_event", "_value", "_error", "t_submit", "t_done",
-                 "deadline", "_cb", "_cb_lock", "_cb_fired")
+                 "deadline", "_cb", "_cb_lock", "_cb_fired", "ctx")
 
     def __init__(self, deadline: Optional[float] = None):
         self._event = threading.Event()
@@ -181,6 +199,7 @@ class ServeFuture:
         self._cb = None
         self._cb_lock = threading.Lock()
         self._cb_fired = False
+        self.ctx = None  # TraceContext when tracing is on (ISSUE 14)
 
     def _run_callback(self) -> None:
         with self._cb_lock:
@@ -240,12 +259,16 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("image", "future", "attempts")
+    __slots__ = ("image", "future", "attempts", "ctx", "ctx_owner")
 
-    def __init__(self, image: np.ndarray, future: ServeFuture):
+    def __init__(self, image: np.ndarray, future: ServeFuture,
+                 ctx=None, ctx_owner: bool = False):
         self.image = image
         self.future = future
         self.attempts = 0    # completed dispatch attempts that failed
+        self.ctx = ctx       # TraceContext (ISSUE 14); stable across
+        self.ctx_owner = ctx_owner  # retries. owner=True: WE minted the
+        # root (standalone serving) and owe the trace its closure
 
 
 class ServingEngine:
@@ -412,10 +435,14 @@ class ServingEngine:
             except queue.Empty:
                 break
             if req not in (_SENTINEL, _WAKE):
-                req.future._fail(EngineClosedError("engine closed"))
+                err = EngineClosedError("engine closed")
+                req.future._fail(err)
+                self._note_request_failed(req, err)
         while self._retry:
-            self._retry.popleft().future._fail(
-                EngineClosedError("engine closed"))
+            req = self._retry.popleft()
+            err = EngineClosedError("engine closed")
+            req.future._fail(err)
+            self._note_request_failed(req, err)
         self._set_state(CLOSED)
         self._m_writer.close()  # final metrics snapshot (when $OBS_METRICS)
 
@@ -443,9 +470,12 @@ class ServingEngine:
                 break
             if req not in (_SENTINEL, _WAKE):
                 req.future._fail(err)
+                self._note_request_failed(req, err)
                 failed += 1
         while self._retry:
-            self._retry.popleft().future._fail(err)
+            req = self._retry.popleft()
+            req.future._fail(err)
+            self._note_request_failed(req, err)
             failed += 1
         self._tracer.event("serve:killed", reason=str(reason)[:200],
                            failed=failed)
@@ -461,9 +491,12 @@ class ServingEngine:
                 break
             if req not in (_SENTINEL, _WAKE):
                 req.future._fail(err)
+                self._note_request_failed(req, err)
                 failed += 1
         while self._retry:
-            self._retry.popleft().future._fail(err)
+            req = self._retry.popleft()
+            req.future._fail(err)
+            self._note_request_failed(req, err)
             failed += 1
         self._set_state(CLOSED)
         self._m_writer.close()
@@ -607,9 +640,28 @@ class ServingEngine:
         with self._lock:
             return dict(self._stats)
 
+    def _req_ctx(self, req: "_Request"):
+        """The context a per-request record should carry: the ROOT when
+        this engine minted it (closure ownership), a child hop when the
+        router did, None when tracing is off."""
+        if req.ctx is None:
+            return None
+        return req.ctx if req.ctx_owner else req.ctx.child()
+
+    def _note_request_failed(self, req: "_Request",
+                             error: BaseException) -> None:
+        """Terminal closure for a request whose root WE minted and whose
+        error is about to surface (retry budget exhausted, engine
+        closed/killed): without it the trace would read as an orphan.
+        Router-minted roots are closed by the router (re-dispatch may
+        still complete them elsewhere)."""
+        if req.ctx is not None and req.ctx_owner:
+            self._tracer.event("serve:failed", ctx=req.ctx,
+                               error=type(error).__name__)
+
     def submit(self, image: np.ndarray, deadline_s: Optional[float] = None,
-               block: bool = True, timeout: Optional[float] = None
-               ) -> ServeFuture:
+               block: bool = True, timeout: Optional[float] = None,
+               ctx=None) -> ServeFuture:
         """Enqueue one request; returns its future immediately.
 
         `deadline_s` (relative seconds) arms deadline shedding: a request
@@ -619,7 +671,11 @@ class ServingEngine:
         stalls the caller — pipelined producers (eval) keep the default
         blocking backpressure instead. An admitted (non-shed) request is
         ACKNOWLEDGED: it completes with a result or a surfaced error,
-        never disappears (the chaos suite's zero-lost-acks invariant)."""
+        never disappears (the chaos suite's zero-lost-acks invariant).
+
+        `ctx` (ISSUE 14): the request's TraceContext — the FleetRouter
+        passes the root it minted; standalone callers leave it None and
+        the engine mints one itself when tracing is on."""
         if self._closed:
             raise EngineClosedError("engine closed")
         image = np.asarray(image)
@@ -632,7 +688,12 @@ class ServingEngine:
         fut = ServeFuture(
             deadline=None if deadline_s is None
             else time.monotonic() + float(deadline_s))
-        req = _Request(image, fut)
+        owner = False
+        if ctx is None and self._tracer.enabled:
+            ctx = new_root()
+            owner = True
+        fut.ctx = ctx
+        req = _Request(image, fut, ctx=ctx, ctx_owner=owner)
         with self._lock:
             self._stats["submitted"] += 1
         self._mc["submitted"].inc()
@@ -642,7 +703,8 @@ class ServingEngine:
             with self._lock:
                 self._stats["shed_queue_full"] += 1
             self._mc["shed_queue_full"].inc()
-            self._tracer.event("serve:shed", reason="queue-full")
+            self._tracer.event("serve:shed", ctx=self._req_ctx(req),
+                               reason="queue-full")
             fut._fail(SheddedError("queue full (admission control)"))
         self._mg_queue.set(self._q.qsize())
         return fut
@@ -661,13 +723,17 @@ class ServingEngine:
         ahead of the admission queue, and a _WAKE token pops a dispatcher
         blocked in q.get() so recovery never waits for fresh traffic."""
         retried, exhausted = 0, 0
+        retried_reqs: List[_Request] = []
+        exhausted_reqs: List[_Request] = []
         for r in live:
             r.attempts += 1
             if r.attempts <= self._max_retries:
                 self._retry.append(r)
                 retried += 1
+                retried_reqs.append(r)
             else:
                 exhausted += 1
+                exhausted_reqs.append(r)
                 r.future._fail(error)
         with self._lock:
             self._stats["failed_batches"] += 1
@@ -687,11 +753,17 @@ class ServingEngine:
             self._mc["requeued_batches"].inc()
         self._mg_retry.set(len(self._retry))
         self._set_state(DEGRADED)
-        self._tracer.event("recover:requeue", stage=stage, b=b, n=retried,
-                           error=type(error).__name__)
+        self._tracer.event(
+            "recover:requeue", stage=stage, b=b, n=retried,
+            links=links_of([r.ctx for r in retried_reqs]) or None,
+            error=type(error).__name__)
         if exhausted:
-            self._tracer.event("recover:retry-exhausted", stage=stage,
-                               n=exhausted, error=type(error).__name__)
+            self._tracer.event(
+                "recover:retry-exhausted", stage=stage, n=exhausted,
+                links=links_of([r.ctx for r in exhausted_reqs]) or None,
+                error=type(error).__name__)
+            for r in exhausted_reqs:
+                self._note_request_failed(r, error)
         if retried:
             try:
                 self._q.put_nowait(_WAKE)
@@ -725,7 +797,8 @@ class ServingEngine:
                 with self._lock:
                     self._stats["shed_deadline"] += 1
                 self._mc["shed_deadline"].inc()
-                self._tracer.event("serve:shed", reason="deadline")
+                self._tracer.event("serve:shed", ctx=self._req_ctx(r),
+                                   reason="deadline")
                 r.future._fail(SheddedError("deadline passed before "
                                             "dispatch"))
             else:
@@ -791,8 +864,11 @@ class ServingEngine:
                 with self._lock:
                     self._dispatch_busy = False
                 continue
+            # fan-in edges: every batch-level span names its member
+            # requests' contexts (None — tracing off — folds to no links)
+            blinks = links_of([r.ctx for r in live]) or None
             with self._dispatch_mutex:
-                with self._tracer.span("serve:batch-form",
+                with self._tracer.span("serve:batch-form", links=blinks,
                                        n=len(live)) as sp_form:
                     b = self._pick_bucket(len(live))
                     # a fresh buffer per batch: the async H2D of the
@@ -805,18 +881,21 @@ class ServingEngine:
                 now = time.monotonic()
                 for r in live:
                     self._tracer.record("serve:queue-wait",
-                                        now - r.future.t_submit)
+                                        now - r.future.t_submit,
+                                        ctx=(r.ctx.child() if r.ctx
+                                             else None))
                     self._mh["queue_wait"].observe(
                         (now - r.future.t_submit) * 1e3)
                 try:
                     if self._injector is not None:
                         self._injector.fire("serve:dispatch", b=b)
-                    with self._tracer.span("serve:h2d", b=b) as sp_h2d:
+                    with self._tracer.span("serve:h2d", b=b,
+                                           links=blinks) as sp_h2d:
                         dev = (jax.device_put(buf, self._sharding)
                                if self._sharding is not None
                                else jax.device_put(buf))
-                    with self._tracer.span("serve:compute",
-                                           b=b) as sp_comp:
+                    with self._tracer.span("serve:compute", b=b,
+                                           links=blinks) as sp_comp:
                         out = self._compiled[b](self._variables, dev)
                 except Exception as e:  # noqa: BLE001 — requeue, serve on
                     self._requeue_or_fail(live, e, stage="dispatch", b=b)
@@ -837,8 +916,13 @@ class ServingEngine:
                 self._mg_fill[b].set(len(live) / b)
                 self._mg_inflight.set(inflight)
                 self._mg_queue.set(self._q.qsize())
-            self._inflight.put((out, live, b))  # depth-bounded: blocks at
-            # `depth` in-flight batches — the pipelining backpressure
+            # the monotonic stamp feeds the serve:inflight-wait span (the
+            # dispatch-done -> fetch-start gap: where a deep pipeline
+            # parks a batch behind its predecessors' D2H — without it the
+            # waterfall cannot attribute a loaded p99, ISSUE 14)
+            self._inflight.put((out, live, b, time.monotonic()))
+            # depth-bounded: blocks at `depth` in-flight batches — the
+            # pipelining backpressure
         self._inflight.put(_SENTINEL)
 
     # ---- fetcher ---------------------------------------------------------
@@ -884,10 +968,14 @@ class ServingEngine:
             item = self._inflight.get()
             if item is _SENTINEL:
                 return
-            out, live, b = item
+            out, live, b, t_inq = item
+            flinks = links_of([r.ctx for r in live]) or None
+            self._tracer.record("serve:inflight-wait",
+                                time.monotonic() - t_inq, b=b,
+                                links=flinks)
             try:
-                with self._tracer.span("serve:d2h", b=b,
-                                       n=len(live)) as sp_d2h:
+                with self._tracer.span("serve:d2h", b=b, n=len(live),
+                                       links=flinks) as sp_d2h:
                     # the ONE sanctioned batched fetch (graftlint
                     # ast/device-get-in-serving-loop polices per-request
                     # fetches; this one D2H serves the whole batch)
@@ -909,7 +997,8 @@ class ServingEngine:
                 r.future._set(type(host)(*(np.asarray(leaf[i])
                                            for leaf in host)))
                 self._tracer.record(
-                    "serve:e2e", r.future.t_done - r.future.t_submit, b=b)
+                    "serve:e2e", r.future.t_done - r.future.t_submit,
+                    ctx=self._req_ctx(r), b=b)
                 self._mh["e2e"].observe(
                     (r.future.t_done - r.future.t_submit) * 1e3)
             with self._lock:

@@ -90,6 +90,19 @@ Design rules, each load-bearing:
   exactly like the engine's, and `health()` returns the per-replica
   digests + tenant/canary state a dashboard (or scripts/obs_report.py's
   Fleet section) wants.
+* **Distributed tracing (ISSUE 14).** With tracing on, `submit` mints
+  the request's ROOT `TraceContext` (obs/trace.py) at the front door
+  and owns its closure: `fleet:e2e` on completion, `fleet:shed` /
+  `fleet:lost` as terminal events — every acknowledged request's trace
+  ends in exactly one of those, which is what lets obs/traceview.py
+  flag orphans as hard errors. Hops are child contexts
+  (`fleet:dispatch` per replica attempt, `fleet:redispatch`,
+  `fleet:dispatch-fault`), and the context rides into
+  `ServingEngine.submit(ctx=...)` so replica-side queue-wait/batch/
+  d2h spans land in the same trace — a request that crossed a replica
+  death reassembles into one causal chain across the router's and both
+  replicas' span records. Tracing off threads None everywhere (zero
+  device-side difference; pinned by tests/test_trace.py).
 
 Enforcement: graftlint's `ast/engine-bypass-in-fleet` flags raw
 ServingEngine construction or `.engine.submit(...)` calls in fleet/router
@@ -107,6 +120,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.trace import new_root
 from .engine import (CLOSED, DEGRADED, DRAINING, EngineClosedError,
                      ServingEngine, SheddedError)
 
@@ -143,7 +157,7 @@ class FleetFuture:
     `redispatches`). First-wins like ServeFuture."""
 
     __slots__ = ("_event", "_value", "_error", "t_submit", "t_done",
-                 "deadline", "tenant", "replicas", "redispatches")
+                 "deadline", "tenant", "replicas", "redispatches", "ctx")
 
     def __init__(self, tenant: str, deadline: Optional[float] = None):
         self._event = threading.Event()
@@ -155,6 +169,7 @@ class FleetFuture:
         self.tenant = tenant
         self.replicas: List[int] = []
         self.redispatches = 0
+        self.ctx = None  # root TraceContext when tracing is on (ISSUE 14)
 
     def _set(self, value) -> bool:
         if self._event.is_set():
@@ -217,14 +232,16 @@ class _Tenant:
 
 
 class _Request:
-    __slots__ = ("image", "future", "attempts", "tier")
+    __slots__ = ("image", "future", "attempts", "tier", "ctx")
 
     def __init__(self, image: np.ndarray, future: FleetFuture,
-                 tier: Optional[str] = None):
+                 tier: Optional[str] = None, ctx=None):
         self.image = image
         self.future = future
         self.attempts = 0  # re-dispatches consumed
         self.tier = tier   # tier pin (ISSUE 13): None = any replica
+        self.ctx = ctx     # root TraceContext (ISSUE 14): the router
+        # mints it and owns the closure; replicas only add child hops
 
 
 class FleetRouter:
@@ -519,6 +536,8 @@ class FleetRouter:
             except Exception as e:  # noqa: BLE001 — routing-layer fault
                 self._mc["dispatch_faults"].inc()
                 self._tracer.event("fleet:dispatch-fault",
+                                   ctx=(req.ctx.child() if req.ctx
+                                        else None),
                                    error=type(e).__name__)
                 # transient front-door fault: the request is still ours;
                 # fall through and route it (bounded by the schedule)
@@ -534,15 +553,27 @@ class FleetRouter:
             eng = rep.engine  # pin: a respawn may swap rep.engine later
             try:
                 sf = eng.submit(req.image, deadline_s=remaining,
-                                block=False)
+                                block=False, ctx=req.ctx)
             except EngineClosedError:
                 continue  # raced a death; next candidate
             err = sf.exception()
             if err is not None and isinstance(err, SheddedError):
                 continue  # this replica's queue is full; next candidate
             fut.replicas.append(rep.rid)
-            self._tracer.event("fleet:dispatch", rid=rep.rid,
-                               tenant=fut.tenant)
+            # the submit -> this-dispatch window as a named stage: router
+            # turnaround (admission, scoring, host scheduling) and — on a
+            # re-dispatch — the whole failed previous hop; without it a
+            # starved-host or re-dispatched p99 waterfall cannot
+            # attribute its leading gap (ISSUE 14)
+            self._tracer.record("fleet:dispatch-wait",
+                                time.monotonic() - fut.t_submit,
+                                ctx=(req.ctx.child() if req.ctx
+                                     else None),
+                                rid=rep.rid, attempt=req.attempts)
+            self._tracer.event("fleet:dispatch",
+                               ctx=(req.ctx.child() if req.ctx
+                                    else None),
+                               rid=rep.rid, tenant=fut.tenant)
             sf.add_done_callback(
                 lambda f, req=req, rid=rep.rid, eng=eng:
                 self._on_replica_done(req, rid, eng, f))
@@ -560,7 +591,9 @@ class FleetRouter:
             t.c_shed.inc()
         self._mc["shed_deadline" if reason == "deadline"
                  else "shed_capacity"].inc()
-        self._tracer.event("fleet:shed", reason=reason, tenant=fut.tenant)
+        # the shed IS the trace's closure: the router minted the root
+        self._tracer.event("fleet:shed", ctx=req.ctx, reason=reason,
+                           tenant=fut.tenant)
 
     def _on_replica_done(self, req: _Request, rid: int, engine,
                          sf) -> None:
@@ -584,6 +617,13 @@ class FleetRouter:
                     self._tenant_alerts(fired)
                 self._mc["completed"].inc()
                 self._mh_e2e.observe(e2e_ms)
+                # the fleet-level e2e closes the trace the router minted
+                # (the replica's serve:e2e is a child hop of it)
+                self._tracer.record("fleet:e2e",
+                                    fut.t_done - fut.t_submit,
+                                    ctx=req.ctx, tenant=fut.tenant,
+                                    rid=rid,
+                                    redispatches=fut.redispatches)
                 self._m_writer.maybe_flush()
             return
         if isinstance(err, SheddedError):
@@ -597,8 +637,10 @@ class FleetRouter:
             req.attempts += 1
             fut.redispatches += 1
             self._mc["redispatched"].inc()
-            self._tracer.event("fleet:redispatch", rid=rid,
-                               attempt=req.attempts,
+            self._tracer.event("fleet:redispatch",
+                               ctx=(req.ctx.child() if req.ctx
+                                    else None),
+                               rid=rid, attempt=req.attempts,
                                error=type(err).__name__)
             if self._dispatch(req, exclude_engines={id(engine)}):
                 return
@@ -611,7 +653,9 @@ class FleetRouter:
                 fired = self._watchdog.check()
                 self._tenant_alerts(fired)
             self._mc["lost"].inc()
-            self._tracer.event("fleet:lost", tenant=fut.tenant,
+            # a surfaced error is still a closure: the trace ends here
+            self._tracer.event("fleet:lost", ctx=req.ctx,
+                               tenant=fut.tenant,
                                error=type(err).__name__)
 
     # ---- client API ------------------------------------------------------
@@ -645,7 +689,12 @@ class FleetRouter:
                              % (tier, sorted(set(self._tiers))))
         fut = FleetFuture(tenant, deadline=None if deadline_s is None
                           else time.monotonic() + float(deadline_s))
-        req = _Request(np.asarray(image), fut, tier=tier)
+        # the ROOT trace context is minted here, at the fleet front door
+        # (ISSUE 14): it rides through tenant admission, dispatch
+        # scoring, the canary split, every replica hop and re-dispatch
+        ctx = new_root() if self._tracer.enabled else None
+        fut.ctx = ctx
+        req = _Request(np.asarray(image), fut, tier=tier, ctx=ctx)
         self._mc["submitted"].inc()
         # fleet:replica chaos: a worker-death kills the replica the
         # request WOULD have routed to (submit path only — never from an
@@ -682,7 +731,7 @@ class FleetRouter:
             else:
                 to_canary = False
         if shed_reason is not None:
-            self._tracer.event("fleet:shed", reason=shed_reason,
+            self._tracer.event("fleet:shed", ctx=ctx, reason=shed_reason,
                                tenant=tenant)
             return fut
         if not self._dispatch(req, exclude_engines=set(),

@@ -1619,8 +1619,17 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
         data_t = time.time() - tic
         meters["data"].update(data_t)
         mh_wait.observe(data_t * 1e3)
+        sctx = None
         if tracer.enabled:
-            tracer.record("loader-wait", data_t, epoch=epoch, it=i)
+            # per-step trace context (ISSUE 14): the trace id derives
+            # from (run, epoch, step) alone, so every rank's span log
+            # contributes to the SAME per-step trace with zero
+            # coordination — obs/traceview.py joins them by rank tag
+            from .obs.trace import step_context
+            sctx = step_context(epoch_base_step + i, epoch=epoch,
+                                rank=int(getattr(cfg, "rank", 0) or 0))
+            tracer.record("loader-wait", data_t, ctx=sctx.child(),
+                          epoch=epoch, it=i)
 
         if profile_this_epoch and is_chief and i == 2:
             # steps 0-1 include compiles; trace a few steady-state steps
@@ -1648,7 +1657,8 @@ def train_epoch(cfg: Config, epoch: int, loader: BatchLoader, step_runner,
         if tracer.enabled:
             # async-dispatch time (+ the flush barrier's device wait when
             # this was a flush iteration) — same semantics as the meter
-            tracer.record("step", step_t, epoch=epoch, it=i)
+            tracer.record("step", step_t, ctx=sctx.child(),
+                          epoch=epoch, it=i)
 
         if profiling and i >= 7:
             flush_losses()  # completion barrier: the trace must contain
@@ -1858,6 +1868,12 @@ def train(cfg: Config, chaos=None) -> TrainState:
     if monitor is not None and tracer.enabled:
         monitor._tracer = tracer  # recover:* events join the span log
     recompiles = None
+    if tracer.enabled:
+        # rank tag on every record (ISSUE 14): N per-rank span logs join
+        # into per-step traces (obs/traceview.py) — the tag is what maps
+        # a slow span back to the rank that wrote it
+        tracer.bind(rank=int(getattr(cfg, "rank", 0) or 0),
+                    world=int(getattr(cfg, "world_size", 1) or 1))
     if tracer.enabled:
         from .obs.telemetry import install_recompile_counter
         recompiles = install_recompile_counter(tracer)

@@ -100,6 +100,12 @@ def measure(devices: int, world: int, rank: int, global_batch: int,
                                                       make_train_step_body)
 
     tracer = maybe_tracer()
+    if tracer.enabled:
+        # rank-tagged records + a per-step trace id derived from the row
+        # config alone (ISSUE 14): every rank of a multi-process row
+        # contributes to the SAME trace, so obs/traceview.py joins the
+        # per-rank span logs into one cross-process step trace
+        tracer.bind(rank=int(rank), world=int(world))
     cfg = Config(num_stack=1,
                  hourglass_inch=128 if imsize >= 256 else 32,
                  num_cls=2, batch_size=global_batch)
@@ -146,8 +152,15 @@ def measure(devices: int, world: int, rank: int, global_batch: int,
     # (so donation has an output to alias) which must never enter the D2H
     dt = timed_fetch(lambda *a: compiled(*a)[1], (state, *arrs), overhead,
                      repeats=1)
-    tracer.record("scale:step", dt / iters, devices=devices, world=world,
-                  batch=global_batch)
+    sctx = None
+    if tracer.enabled:
+        from real_time_helmet_detection_tpu.obs.trace import step_context
+        sctx = step_context(0, epoch=devices, rank=int(rank),
+                            run="scaling-d%d-b%d-w%d"
+                            % (devices, global_batch, world))
+    tracer.record("scale:step", dt / iters,
+                  ctx=(sctx.child() if sctx is not None else None),
+                  devices=devices, world=world, batch=global_batch)
     platform = jax.devices()[0].platform
     return {
         "devices": devices, "processes": world,

@@ -290,6 +290,29 @@ FLEET_FIXTURES = {
 
 
 SERVING_FIXTURES = {
+    # trace-context hygiene (ISSUE 14): a request-path span without
+    # ctx=/links= in serving code is invisible to the waterfall
+    # assembler; lifecycle spans and context-carrying emissions pass
+    "context-free-span": (
+        # serve:shed (a per-request terminal!) emitted context-free, and
+        # a batch d2h span without its fan-in links
+        "def shed(tracer, req):\n"
+        "    tracer.event('serve:shed', reason='deadline')\n"
+        "def fetch(self, b, live):\n"
+        "    with self._tracer.span('serve:d2h', b=b):\n"
+        "        pass\n",
+        # the same sites carrying their contexts + an exempt lifecycle
+        # span + a non-request span name (untraced bench section is fine)
+        "def shed(tracer, req):\n"
+        "    tracer.event('serve:shed', ctx=req.ctx, reason='deadline')\n"
+        "def fetch(self, b, live, links):\n"
+        "    with self._tracer.span('serve:d2h', b=b, links=links):\n"
+        "        pass\n"
+        "def lifecycle(tracer):\n"
+        "    tracer.event('serve:state', **{'from': 'a', 'to': 'b'})\n"
+        "    with tracer.span('serve:compile', b=4):\n"
+        "        pass\n",
+    ),
     # rules scoped to the serving package render at a serving/ path
     "device-get-in-serving-loop": (
         # a per-request fetch inside the batch loop — the sync the engine

@@ -18,12 +18,18 @@ ONE artifact:
 * SLO alert events                 (`alert:*` in the span logs, joined
   into one timeline with the `fault:*`/`recover:*` evidence so a
   post-mortem reads what the watchdog saw next to what actually broke
-  and what healed; ISSUE 10).
+  and what healed; ISSUE 10),
+* trace contexts                   (ISSUE 14: the optional trace/span/
+  parent/links fields on span records, reassembled by obs/traceview.py
+  into per-request waterfalls — the **Traces** section carries the
+  completeness verdict (orphans/broken chains are HARD errors), stage
+  shares, the slowest requests' waterfalls and the fault/fleet events
+  joined into traces; per-request questions start HERE).
 
 Output: `artifacts/<round>/obs/report.md` (human) + `report.json` and ONE
-JSON line on stdout (machine), schema `obs-report-v2` (v1 reports —
-pre-metrics rounds — stay readable via `read_report`, which nulls the
-sections v1 lacks). Everything is read-only over its inputs (the queue
+JSON line on stdout (machine), schema `obs-report-v5` (v1–v4 reports —
+earlier rounds — stay readable via `read_report`, which nulls the
+sections each lacks). Everything is read-only over its inputs (the queue
 journal is parsed tolerantly, torn tails dropped, never repaired in
 place) and CPU-only — run it after any round, chip or not.
 
@@ -56,14 +62,16 @@ from real_time_helmet_detection_tpu.obs.spans import (  # noqa: E402
 from real_time_helmet_detection_tpu.utils import (  # noqa: E402
     atomic_write_bytes, save_json)
 
-SCHEMA = "obs-report-v4"
+SCHEMA = "obs-report-v5"
 READABLE_SCHEMAS = ("obs-report-v1", "obs-report-v2", "obs-report-v3",
-                    "obs-report-v4")
+                    "obs-report-v4", "obs-report-v5")
 # sections older schemas lack; read_report nulls them (v1 lacks every
-# group, v2 lacks Scaling + Fleet, v3 lacks Fleet)
+# group, v2 lacks Scaling + Fleet + Traces, v3 lacks Fleet + Traces,
+# v4 lacks Traces)
 V2_SECTIONS = ("metrics", "slo")
 V3_SECTIONS = ("scaling",)
 V4_SECTIONS = ("fleet",)
+V5_SECTIONS = ("traces",)
 
 
 def read_report(path: str) -> Optional[Dict]:
@@ -80,7 +88,7 @@ def read_report(path: str) -> Optional[Dict]:
     if rep.get("schema") not in READABLE_SCHEMAS:
         log("unreadable report schema %r in %s" % (rep.get("schema"), path))
         return None
-    for section in V2_SECTIONS + V3_SECTIONS + V4_SECTIONS:
+    for section in V2_SECTIONS + V3_SECTIONS + V4_SECTIONS + V5_SECTIONS:
         rep.setdefault(section, None)
     return rep
 
@@ -423,6 +431,36 @@ def summarize_fleet(paths: List[str]) -> Optional[Dict]:
             "rollouts": rollouts, "timeline": timeline}
 
 
+def summarize_traces(paths: List[str], top_n: int = 5) -> Optional[Dict]:
+    """The Traces section (ISSUE 14): reassemble the round's trace
+    contexts (obs/traceview.py) across EVERY span log — router, replica
+    and rank logs join here — into (a) the completeness verdict (orphan
+    spans and broken parent links are HARD errors, not noise), (b)
+    aggregate critical-path stage shares over the closed request traces,
+    (c) the top-N slowest requests' waterfalls, and (d) a join of the
+    `fault:*`/`recover:*`/`fleet:*` events that landed INSIDE traces —
+    a post-mortem reads which request a fault actually hit. Returns None
+    when the round recorded no traced spans (every pre-ISSUE round)."""
+    from real_time_helmet_detection_tpu.obs import traceview
+    traces = traceview.assemble_logs(paths)
+    if not traces:
+        return None
+    summary = traceview.analyze(traces)
+    exemplars = traceview.tail_exemplars(traces, top_n)
+    # events joined INTO traces: which requests did faults/recoveries/
+    # fleet hops actually touch (ctx- or links-carrying events only)
+    joined: Dict[str, int] = {}
+    for t in traces.values():
+        for rec in t.records + t.linked:
+            name = str(rec.get("name", ""))
+            if rec.get("kind") == "event" and name.startswith(
+                    ("fault:", "recover:", "fleet:")):
+                joined[name] = joined.get(name, 0) + 1
+    summary["events_in_traces"] = dict(sorted(joined.items()))
+    summary["waterfalls"] = exemplars
+    return summary
+
+
 def summarize_queue(queue_dir: Optional[str]) -> Optional[Dict]:
     """Read-only tolerant replay of the job journal: per-job final state,
     attempts, salvage evidence, queued->terminal wall seconds."""
@@ -540,6 +578,7 @@ def build_report(round_name: str, span_paths: List[str],
         "slo": summarize_slo(span_paths),
         "scaling": summarize_scaling(scaling_paths or [], span_paths),
         "fleet": summarize_fleet(span_paths),
+        "traces": summarize_traces(span_paths),
         "queue": summarize_queue(queue_dir),
         "bench": summarize_bench(bench_paths),
         "loss": summarize_loss_log(loss_paths),
@@ -746,6 +785,60 @@ def render_markdown(rep: Dict) -> str:
     else:
         lines.append("_no fleet activity recorded_")
     lines += [""]
+    trc = rep.get("traces")
+    lines += ["## Traces", ""]
+    if trc:
+        lines += ["%d trace(s): %d request trace(s) (%d closed, "
+                  "%d re-dispatched), %d step trace(s)%s"
+                  % (trc["traces"], trc["request_traces"], trc["closed"],
+                     trc["redispatched_traces"], trc["step_traces"],
+                     (" over ranks %s" % trc["step_ranks"]
+                      if trc["step_ranks"] else "")), ""]
+        if trc["orphans"] or trc["broken_chains"]:
+            lines += ["**HARD ERRORS**: %d orphan trace(s) %s, %d broken "
+                      "chain(s) %s — an acknowledged request's causal "
+                      "chain did not close; treat like a lost ack"
+                      % (trc["orphans"], trc["orphan_ids"],
+                         trc["broken_chains"],
+                         [b["trace"] for b in trc["broken_detail"]]), ""]
+        else:
+            lines += ["Completeness: every request trace closed, zero "
+                      "broken chains.", ""]
+        if trc["stage_shares"]:
+            lines += ["Critical-path stage shares (over closed request "
+                      "traces): " + ", ".join(
+                          "%s %.1f%%" % (k, v * 100)
+                          for k, v in trc["stage_shares"].items()), ""]
+        for wf in (trc.get("waterfalls") or [])[:3]:
+            cp = wf["critical_path"]
+            lines += ["Trace `%s` — e2e %.3f ms, dominant stage %s, "
+                      "%.1f%% attributed:"
+                      % (wf["trace"], wf["e2e_ms"],
+                         cp["dominant_stage"],
+                         (cp["attributed_frac"] or 0) * 100), "",
+                      "| rel ms | dur ms | span | fan-in | info |",
+                      "|---|---|---|---|---|"]
+            for row in wf["waterfall"][:20]:
+                info = ", ".join("%s=%s" % (k, row[k])
+                                 for k in ("rid", "b", "rank", "error",
+                                           "reason", "tenant", "stage")
+                                 if k in row)
+                lines.append("| %.3f | %.3f | %s | %s | %s |"
+                             % (row["rel_ms"], row["dur_ms"], row["name"],
+                                "yes" if row["fan_in"] else "",
+                                info))
+            if len(wf["waterfall"]) > 20:
+                lines.append("| ... | | %d more row(s) | | |"
+                             % (len(wf["waterfall"]) - 20))
+            lines += [""]
+        if trc.get("events_in_traces"):
+            lines += ["Events joined into traces: " + ", ".join(
+                "%s ×%d" % (k, v)
+                for k, v in trc["events_in_traces"].items()), ""]
+    else:
+        lines.append("_no traced spans recorded (pre-ISSUE-14 round, or "
+                     "tracing never armed)_")
+    lines += [""]
     q = rep["queue"]
     lines += ["## Queue", ""]
     if q:
@@ -905,9 +998,49 @@ def selfcheck() -> int:
                      error="EngineClosedError")
         tracer.event("fleet:rollback", rid=1, reason="canary-error-burn",
                      alerts=1)
+        # distributed-tracing taxonomy (ISSUE 14): a complete two-hop
+        # request arc (root closure + child hops + a fan-in batch span +
+        # a fault/redispatch joined INTO the trace), an orphan (child,
+        # never closed) and a broken chain (parent never written) — the
+        # Traces section's joins and its hard-error detectors
+        from real_time_helmet_detection_tpu.obs import trace as trace_mod
+        trace_mod.reset_ids(42)
+        tr1 = trace_mod.new_root()
+        tr2 = trace_mod.new_root()
+        tracer.record("serve:queue-wait", 0.004, ctx=tr1.child(), b=2)
+        tracer.record("serve:queue-wait", 0.002, ctx=tr2.child(), b=2)
+        tracer.record("serve:compute", 0.006,
+                      links=trace_mod.links_of([tr1, tr2]), b=2)
+        tracer.event("fault:device-loss", site="serve:dispatch",
+                     ctx=tr1.child())
+        tracer.event("fleet:redispatch", ctx=tr1.child(), rid=0,
+                     attempt=1)
+        tracer.record("fleet:e2e", 0.020, ctx=tr1)
+        tracer.record("fleet:e2e", 0.012, ctx=tr2)
+        orphan = trace_mod.new_root()
+        tracer.record("serve:queue-wait", 0.001, ctx=orphan.child())
+        broken = trace_mod.new_root()
+        tracer.record("serve:queue-wait", 0.001,
+                      ctx=trace_mod.TraceContext(broken.trace_id,
+                                                 "dangling-child",
+                                                 "never-written"))
+        tracer.record("serve:e2e", 0.005, ctx=broken)
         tracer.close()
         with open(span_path, "a") as f:  # graftlint: off=raw-artifact-write
             f.write('{"kind": "span", "torn')  # kill -9 mid-append twin
+
+        # a second (per-rank) span log with a rank-tagged step trace and
+        # a torn TRACED tail: the cross-process join + the reader's
+        # recovery contract over trace records specifically
+        span2_path = os.path.join(tmp, "obs", "spans_rank1.jsonl")
+        from real_time_helmet_detection_tpu.obs.spans import SpanTracer
+        t2 = SpanTracer(span2_path)
+        t2.bind(rank=1, world=2)
+        t2.record("step", 0.01,
+                  ctx=trace_mod.step_context(0, rank=1, run="fix"))
+        t2.close()
+        with open(span2_path, "a") as f:  # graftlint: off=raw-artifact-write
+            f.write('{"kind": "span", "name": "serve:e2e", "trace": "to')
 
         # queue journal: done + salvaged->failed arcs, torn tail
         qdir = os.path.join(tmp, "queue")
@@ -988,7 +1121,8 @@ def selfcheck() -> int:
                                       "step_ms": 441.0,
                                       "sharding_efficiency": 0.88}]}})
 
-        ns = argparse.Namespace(round="rXX", span_log=[span_path],
+        ns = argparse.Namespace(round="rXX",
+                                span_log=[span_path, span2_path],
                                 queue_dir=qdir, bench=[bench_path],
                                 loss_log=[loss_path],
                                 metrics=[metrics_path],
@@ -999,31 +1133,34 @@ def selfcheck() -> int:
         check("schema tagged", rep["schema"] == SCHEMA)
         sp = rep["spans"]
         check("torn span tail dropped, all real records read",
-              sp["records"] == 49)  # meta + 4 steps + ckpt + hb + ctx
+              sp["records"] == 61)  # meta + 4 steps + ckpt + hb + ctx
         # + 16 serve spans + shed event + 7 fault/recover events +
         # reload span + 2 alert events + 4 scale spans + 10 fleet events
+        # + 10 trace-fixture records + log2's meta + rank-1 step (both
+        # torn tails dropped)
         check("step span stats", sp["by_name"].get("step", {}).get(
-            "count") == 4 and abs(sp["by_name"]["step"]["total_s"]
-                                  - 0.1) < 1e-6)
+            "count") == 5 and abs(sp["by_name"]["step"]["total_s"]
+                                  - 0.11) < 1e-6)
         check("heartbeat event counted",
               sp["events"].get("heartbeat") == 1)
         check("context sampled", sp["context"]["samples"] == 1)
         srv = rep["serving"]
         check("serving section joined", srv is not None
-              and srv["requests"] == 4 and srv["batches"] == 2
+              and srv["requests"] == 5 and srv["batches"] == 2
               and srv["shed"] == {"queue-full": 1})
-        # nearest-rank percentiles over [10, 20, 30, 40] ms: p50 idx
-        # round(0.5*3)=2 -> 30, p99 idx 3 -> 40
+        # nearest-rank percentiles over [5, 10, 20, 30, 40] ms (the
+        # trace fixtures add a 5 ms e2e): p50 idx round(0.5*4)=2 -> 20,
+        # p99 idx 4 -> 40
         check("serving p50/p99 computed",
-              srv["e2e"]["p50_ms"] == 30.0 and srv["e2e"]["p99_ms"] == 40.0
-              and srv["queue_wait"]["count"] == 4)
+              srv["e2e"]["p50_ms"] == 20.0 and srv["e2e"]["p99_ms"] == 40.0
+              and srv["queue_wait"]["count"] == 8)
         check("serving stage digests + fill",
               set(srv["stages"]) == {"batch-form", "h2d", "compute", "d2h"}
               and srv["mean_batch_fill"] == 2.0)
         flt = rep["faults"]
         check("faults section joined", flt is not None
-              and flt["injected"] == {"device-loss": 1, "nan-batch": 1}
-              and flt["by_site"] == {"serve:dispatch": 1,
+              and flt["injected"] == {"device-loss": 2, "nan-batch": 1}
+              and flt["by_site"] == {"serve:dispatch": 2,
                                      "train:batch": 1})
         check("recovery evidence joined",
               flt["recoveries"].get("requeue") == 1
@@ -1072,7 +1209,7 @@ def selfcheck() -> int:
         check("fleet section joined", ft is not None
               and ft["dispatches_by_replica"] == {"0": 2, "1": 1}
               and ft["dispatches_total"] == 3
-              and ft["redispatches"] == 1
+              and ft["redispatches"] == 2
               and ft["shed"] == {"tenant-budget": 1}
               and ft["tenants_shed"] == {"bulk": 1})
         check("fleet lifecycle + canary joined",
@@ -1086,6 +1223,24 @@ def selfcheck() -> int:
               and (ft_names.index("fleet:rollout rid=1")
                    < ft_names.index(
                        "fleet:rollback rid=1 (canary-error-burn)")))
+        trc = rep["traces"]
+        check("traces section joined", trc is not None
+              and trc["request_traces"] == 4 and trc["closed"] == 3
+              and trc["redispatched_traces"] == 1)
+        check("traces hard errors detected",
+              trc["orphans"] == 1 and trc["broken_chains"] == 1
+              and trc["complete"] == 2)
+        check("traces step join carries rank",
+              trc["step_traces"] == 1 and trc["step_ranks"] == [1])
+        check("traces waterfalls + joined events",
+              trc["waterfalls"]
+              and trc["waterfalls"][0]["e2e_ms"] == 20.0
+              and trc["waterfalls"][0]["critical_path"][
+                  "dominant_stage"] == "serve:compute"
+              and any(r["fan_in"] for r in
+                      trc["waterfalls"][0]["waterfall"])
+              and trc["events_in_traces"].get("fault:device-loss") == 1
+              and trc["events_in_traces"].get("fleet:redispatch") == 1)
         q = rep["queue"]
         check("queue states joined", q is not None
               and q["jobs"]["bench"]["state"] == "done"
@@ -1107,9 +1262,9 @@ def selfcheck() -> int:
         md = open(os.path.join(tmp, "out", "report.md")).read()
         check("markdown carries queue table", "| bench | done |" in md)
         check("markdown carries serving section",
-              "## Serving" in md and "e2e latency: p50 30.000 ms" in md)
+              "## Serving" in md and "e2e latency: p50 20.000 ms" in md)
         check("markdown carries faults section",
-              "## Faults" in md and "device-loss ×1" in md
+              "## Faults" in md and "device-loss ×2" in md
               and "rollback ×1" in md
               and "serving->degraded ×1" in md)
         check("markdown carries metrics + slo sections",
@@ -1122,6 +1277,10 @@ def selfcheck() -> int:
               "## Fleet" in md and "rid 0 ×2" in md
               and "replica-death ×1" in md and "rollback ×1" in md
               and "tenant penalty boxes: bulk ×1" in md)
+        check("markdown carries traces section",
+              "## Traces" in md and "HARD ERRORS" in md
+              and "dominant stage serve:compute" in md
+              and "fleet:redispatch ×1" in md)
 
         # schema compat: the generated v2 report reads back through
         # read_report, and a committed v1 report (a pre-ISSUE-10 round)
@@ -1165,6 +1324,22 @@ def selfcheck() -> int:
               v3 is not None and v3["fleet"] is None
               and v3["scaling"] is not None
               and v3["spans"]["records"] == 7)
+        check("v1-v3 reports null the traces section",
+              v1["traces"] is None and v2["traces"] is None
+              and v3["traces"] is None)
+        # a committed v4 report (pre-ISSUE-14 round) nulls only Traces
+        v4_path = os.path.join(tmp, "report_v4.json")
+        atomic_write_bytes(v4_path, json.dumps(
+            {"schema": "obs-report-v4", "round": "r15",
+             "metrics": {"files": []}, "slo": None,
+             "scaling": {"files": [], "spans": {}},
+             "fleet": {"dispatches_total": 3},
+             "spans": {"records": 9}}).encode())
+        v4 = read_report(v4_path)
+        check("v4 report readable with traces nulled",
+              v4 is not None and v4["traces"] is None
+              and v4["fleet"] is not None
+              and v4["spans"]["records"] == 9)
         junk_path = os.path.join(tmp, "report_junk.json")
         atomic_write_bytes(junk_path, json.dumps(
             {"schema": "obs-report-v9"}).encode())

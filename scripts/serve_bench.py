@@ -71,6 +71,17 @@ for END-TO-END request latency because the result fetch is a real D2H.
   `lost_acks == 0` (the fleet half of the zero-lost-acks invariant).
   The ONE JSON line gains `replicas`/`tenants`/`canary` fields.
 
+* **tail exemplars (`--trace-exemplars N`, ISSUE 14)** — the load run
+  records trace contexts (obs/trace.py rides the engine/fleet span
+  taxonomy; a temp span log is armed automatically when none is
+  configured) and the artifact embeds the N slowest requests' FULL
+  reassembled waterfalls + critical paths (obs/traceview.py), plus the
+  trace-completeness summary (orphans/broken chains — both must be 0:
+  every acknowledged request reassembles into one causal chain, re-
+  dispatch hops included). The ONE JSON line gains
+  `exemplar_p99_stage`: the dominant stage of the slowest exemplar —
+  every p99 claim ships with its explanation.
+
 Artifact: `artifacts/<round>/serving/serve_bench.json`, schema
 **serve-bench-v1**, atomic write; ONE JSON line on stdout (repo
 convention). `--selfcheck` proves the engine contract (bit-identity vs
@@ -112,6 +123,33 @@ from real_time_helmet_detection_tpu.utils import save_json  # noqa: E402
 SCHEMA = "serve-bench-v1"
 FLEET_SCHEMA = "serve-bench-fleet-v1"
 HB = maybe_job_heartbeat()
+
+
+def arm_trace_log(args, tracer):
+    """Tail exemplars need span records (ISSUE 14): when exemplars are
+    requested and no span log is configured, arm a temp one — the
+    waterfalls land in the ARTIFACT; the raw log is scratch."""
+    from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
+    if args.trace_exemplars > 0 and not tracer.enabled:
+        import tempfile
+        d = tempfile.mkdtemp(prefix="serve_bench_trace.")
+        tracer = maybe_tracer(os.path.join(d, "spans.jsonl"))
+    return tracer
+
+
+def trace_sections(tracer, n: int):
+    """(trace_exemplars, trace_summary) artifact sections from the run's
+    span log: slowest-N waterfalls + the completeness analysis (orphans
+    and broken chains are HARD errors — the fleet acceptance gate).
+    (None, None) when tracing never armed."""
+    if not tracer.enabled or n <= 0:
+        return None, None
+    from real_time_helmet_detection_tpu.obs import traceview
+    tracer.close()
+    traces = traceview.assemble_logs([tracer.path])
+    summary = traceview.analyze(traces)
+    exemplars = traceview.tail_exemplars(traces, n)
+    return {"n": n, "exemplars": exemplars}, summary
 
 
 def log(msg: str) -> None:
@@ -417,6 +455,22 @@ def _sim_pool(args) -> List[np.ndarray]:
                          dtype=np.uint8) for _ in range(args.pool)]
 
 
+def wait_canary_armed(router, rollout_thread, timeout_s: float = 60.0
+                      ) -> None:
+    """Block until the rollout has picked + reloaded its canary (the
+    router's health() flips `canary` non-None only after the swap) — the
+    deterministic replacement for the old fixed pre-traffic sleep.
+    Control-path polling, mirrors engine.drain's discipline."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline and rollout_thread.is_alive():
+        if router.health()["canary"] is not None:
+            return
+        time.sleep(0.005)
+    if not rollout_thread.is_alive():
+        return  # rollout already resolved (its outcome tells the story)
+    raise RuntimeError("canary never armed within %.0fs" % timeout_s)
+
+
 def fleet_canary_run(args, predict, variables, pool, tracer) -> Dict:
     """The fault-injected canary-rollback proof over REAL engines: faults
     armed on the canary replica burn its error budget mid-rollout, the
@@ -462,7 +516,12 @@ def fleet_canary_run(args, predict, variables, pool, tracer) -> Dict:
         res=router.rollout(new_vars, canary_frac=0.9, window=100_000,
                            timeout_s=60.0)), daemon=True)
     rt.start()
-    time.sleep(0.2)  # canary picked + reloaded on the quiescent fleet
+    # deterministic arming (ISSUE 14 satellite — the canary flake class):
+    # wait for the rollout to PICK + RELOAD the canary on the quiescent
+    # fleet before any traffic flows; a fixed sleep here was box-speed
+    # dependent (a slow box let traffic race the pick, so the canary
+    # could land on the un-injected replica and the watchdog never fired)
+    wait_canary_armed(router, rt)
     th = threading.Thread(target=traffic, daemon=True)
     th.start()
     rt.join(timeout=120)
@@ -499,14 +558,26 @@ def fleet_death_run(args, predict, variables, pool, tracer) -> Dict:
     """The fleet:replica acceptance run over REAL engines: a seeded
     worker-death kills a live replica mid-stream (plus a fleet:dispatch
     device-loss at the front door); re-dispatch + respawn keep every
-    acknowledged request — lost_acks must be 0."""
+    acknowledged request — lost_acks must be 0. `--faults` overrides the
+    canned schedule (the `seed=N` shorthand draws over the FLEET sites
+    here, spread across the burst)."""
+    from real_time_helmet_detection_tpu.runtime.faults import FLEET_SITES
     buckets = tuple(b for b in sorted(set(args.buckets)) if b <= 4) or (1,)
     factory = make_replica_factory(predict, variables, args.imsize,
                                    buckets, queue_capacity=64,
                                    max_wait_ms=1.0, tracer=tracer)
-    inj = ChaosInjector(FaultSchedule.parse(
-        "fleet:dispatch=device-loss@3,fleet:replica=worker-death@40"),
-        tracer=tracer)
+    spec = (args.faults or "").strip()
+    if spec.startswith("seed="):
+        opts = dict(p.split("=", 1) for p in spec.split(",") if "=" in p)
+        sched = FaultSchedule.seeded(int(opts["seed"]),
+                                     n=int(opts.get("n", 3)),
+                                     sites=FLEET_SITES, max_at=40)
+    elif spec:
+        sched = FaultSchedule.parse(spec)
+    else:
+        sched = FaultSchedule.parse(
+            "fleet:dispatch=device-loss@3,fleet:replica=worker-death@40")
+    inj = ChaosInjector(sched, tracer=tracer)
     router = FleetRouter(factory, 2, metrics=MetricsRegistry(),
                          default_budget=100_000, injector=inj,
                          tracer=tracer)
@@ -543,7 +614,7 @@ def run_fleet_bench(args) -> Dict:
         % (platform, list(args.replicas)))
     HB.beat("backend up (%s, fleet)" % platform)
     from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
-    tracer = maybe_tracer(args.span_log or None)
+    tracer = arm_trace_log(args, maybe_tracer(args.span_log or None))
 
     out: Dict = {"schema": FLEET_SCHEMA, "tool": "serve_bench",
                  "platform": platform, "imsize": args.imsize,
@@ -575,6 +646,24 @@ def run_fleet_bench(args) -> Dict:
     out["gate_zero_lost_acks"] = bool(
         out["canary"]["lost_acks"] == 0 and out["death"]["lost_acks"] == 0
         and all(r["lost"] == 0 for r in out["rows"]))
+    # tail exemplars + trace completeness over the WHOLE fleet run
+    # (scaling rows + canary + death — re-dispatch hops included): every
+    # acknowledged request must reassemble into one causal chain
+    exemplars, tsummary = trace_sections(tracer, args.trace_exemplars)
+    if exemplars is not None:
+        out["trace_exemplars"] = exemplars
+        out["trace_summary"] = tsummary
+        if exemplars["exemplars"]:
+            out["exemplar_p99_stage"] = \
+                exemplars["exemplars"][0]["critical_path"]["dominant_stage"]
+        out["gate_traces_complete"] = bool(
+            tsummary["orphans"] == 0 and tsummary["broken_chains"] == 0
+            and tsummary["request_traces"] > 0)
+        log("trace gate: %d request traces, orphans %d, broken %d, "
+            "redispatched %d, p99 stage %s"
+            % (tsummary["request_traces"], tsummary["orphans"],
+               tsummary["broken_chains"], tsummary["redispatched_traces"],
+               out.get("exemplar_p99_stage")))
     log("fleet gates: scaling>=0.8 %s, zero lost acks %s"
         % (out["gate_scaling_08"], out["gate_zero_lost_acks"]))
     return out
@@ -632,7 +721,7 @@ def run_bench(args) -> Dict:
     HB.beat("backend up (%s)" % platform)
     from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
     from real_time_helmet_detection_tpu.serving import ServingEngine
-    tracer = maybe_tracer(args.span_log or None)
+    tracer = arm_trace_log(args, maybe_tracer(args.span_log or None))
 
     cfg, predict, variables, pool = build_parts(args, jax)
     out: Dict = {"schema": SCHEMA, "tool": "serve_bench",
@@ -755,6 +844,19 @@ def run_bench(args) -> Dict:
                               deadline_s, rate)
     out["serial_overload"] = serial_over
     HB.beat("serial overload done")
+
+    # tail exemplars (ISSUE 14): slowest-N waterfalls + completeness
+    exemplars, tsummary = trace_sections(tracer, args.trace_exemplars)
+    if exemplars is not None:
+        out["trace_exemplars"] = exemplars
+        out["trace_summary"] = tsummary
+        if exemplars["exemplars"]:
+            out["exemplar_p99_stage"] = \
+                exemplars["exemplars"][0]["critical_path"]["dominant_stage"]
+        log("trace exemplars: %d, orphans %d, broken %d, p99 stage %s"
+            % (len(exemplars["exemplars"]), tsummary["orphans"],
+               tsummary["broken_chains"],
+               out.get("exemplar_p99_stage")))
 
     eng_over = next(r for r in curve if r["load_multiplier"] == over)
     ratio = eng_over["goodput_rps"] / max(serial_over["goodput_rps"], 1e-6)
@@ -1067,6 +1169,7 @@ def selfcheck() -> int:
                       "tenants": ["bulk", "flagged"],
                       "canary": {"outcome": "rolled-back",
                                  "lost_acks": 0},
+                      "exemplar_p99_stage": "serve:queue-wait",
                       "rows": rows_sim}
         artf = os.path.join(tmp, "serve_bench_fleet.json")
         save_json(artf, fleet_line, indent=1)
@@ -1076,9 +1179,87 @@ def selfcheck() -> int:
               backf["schema"] == FLEET_SCHEMA
               and backf["replicas"] == [1, 2]
               and backf["tenants"] == ["bulk", "flagged"]
-              and backf["canary"]["lost_acks"] == 0)
+              and backf["canary"]["lost_acks"] == 0
+              and backf["exemplar_p99_stage"] == "serve:queue-wait")
         print("selfcheck fleet section elapsed %.1fs"
               % sp_fleet.close(), file=sys.stderr, flush=True)
+
+        # ---- distributed tracing (ISSUE 14): exemplar reassembly over
+        # a fixed-service sim engine (span-sum must explain the e2e) and
+        # a canned fleet:replica death whose re-dispatch hop is visible
+        # in the reassembled trace — with ZERO orphans/broken chains ----
+        from real_time_helmet_detection_tpu.obs import traceview
+        sp_tr = maybe_tracer(None).span(
+            "serve-bench:selfcheck-traces").__enter__()
+        tpath = os.path.join(tmp, "trace_spans.jsonl")
+        ttr = maybe_tracer(tpath)
+        # 80 ms fixed service: compute dominates e2e by construction, so
+        # the span-sum pin is load-independent (the repo box's speed
+        # varies ~2x — CLAUDE.md)
+        st_eng = ServingEngine(SimServePredict(80.0), {"w": np.zeros(1)},
+                               (64, 64, 3), np.uint8, buckets=(1, 2),
+                               max_wait_ms=1.0, queue_capacity=32,
+                               metrics=MetricsRegistry(), tracer=ttr)
+        # sequential (no queueing): each request's e2e IS one 80 ms
+        # compute + slop, so the dominant-stage pin is deterministic
+        for i in range(4):
+            st_eng.submit(pool[i % len(pool)]).result(timeout=30)
+        st_eng.close()
+        ttr.close()
+        traces = traceview.assemble_logs([tpath])
+        summ = traceview.analyze(traces)
+        ex = traceview.tail_exemplars(traces, 3)
+        check("traces: engine stream complete (no orphans/broken)",
+              summ["request_traces"] == 4 and summ["orphans"] == 0
+              and summ["broken_chains"] == 0)
+        cp = ex[0]["critical_path"] if ex else {}
+        check("traces: exemplar e2e equals its span-sum (tolerance)",
+              len(ex) == 3
+              and abs(cp["stage_sum_ms"] - cp["e2e_ms"])
+              <= max(0.5 * cp["e2e_ms"], 40.0)
+              and (cp["attributed_frac"] or 0) >= 0.5)
+        check("traces: compute dominates the fixed-service exemplar",
+              cp.get("dominant_stage") == "serve:compute")
+
+        tpath2 = os.path.join(tmp, "trace_fleet.jsonl")
+        ttr2 = maybe_tracer(tpath2)
+        factory_t = make_replica_factory(
+            SimServePredict(20.0), {"w": np.zeros(1)}, 64, (1, 2),
+            queue_capacity=64, max_wait_ms=1.0, tracer=ttr2)
+        injt = ChaosInjector(FaultSchedule.parse(
+            "fleet:replica=worker-death@30"), tracer=ttr2)
+        frt = FleetRouter(factory_t, 2, metrics=MetricsRegistry(),
+                          injector=injt, tracer=ttr2)
+        # dense burst: backlog must exist when the death fires, so the
+        # killed queued acks exercise the re-dispatch path
+        futt = [frt.submit(pool[k % len(pool)]) for k in range(40)]
+        lostt = 0
+        for f in futt:
+            try:
+                f.result(timeout=60)
+            except Exception:  # noqa: BLE001 — would be a lost ack
+                lostt += 1
+        stt = frt.stats()
+        frt.close()
+        ttr2.close()
+        traces2 = traceview.assemble_logs([tpath2])
+        summ2 = traceview.analyze(traces2)
+        check("traces: death run reassembles completely",
+              lostt == 0 and summ2["request_traces"] == 40
+              and summ2["orphans"] == 0
+              and summ2["broken_chains"] == 0)
+        hop_traces = [t for t in traces2.values()
+                      if any(r.get("name") == "fleet:redispatch"
+                             for r in t.records)]
+        check("traces: re-dispatch hop visible in reassembled trace",
+              stt["redispatched"] >= 1 and len(hop_traces) >= 1
+              and summ2["redispatched_traces"] == len(hop_traces)
+              and all(t.root_closure() is not None for t in hop_traces)
+              and any(sum(1 for r in t.records
+                          if r.get("name") == "fleet:dispatch") >= 2
+                      for t in hop_traces))
+        print("selfcheck traces section elapsed %.1fs"
+              % sp_tr.close(), file=sys.stderr, flush=True)
 
     ok = not failures
     print(json.dumps({"tool": "serve_bench", "selfcheck": True, "ok": ok,
@@ -1172,6 +1353,11 @@ def main(argv=None) -> int:
                         "detected instead of waited out)")
     p.add_argument("--span-log", default="",
                    help="flight-recorder span log (else $OBS_SPAN_LOG)")
+    p.add_argument("--trace-exemplars", type=int, default=3,
+                   help="embed the N slowest requests' reassembled "
+                        "waterfalls + the trace-completeness summary in "
+                        "the artifact (ISSUE 14; 0 disables — a temp "
+                        "span log is armed when none is configured)")
     p.add_argument("--out", default=None,
                    help="artifact path (default artifacts/<round>/serving/"
                         "serve_bench.json)")

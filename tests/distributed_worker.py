@@ -84,6 +84,20 @@ def main() -> None:
     local = tuple(a[rank * per:(rank + 1) * per] for a in g)
     arrays = shard_batch(mesh, local, spatial_dims=[1] * 5)
 
+    # Flight recorder + trace contexts (ISSUE 14): when the launcher
+    # exports a per-rank $OBS_SPAN_LOG, every record carries the rank tag
+    # and the one executed step lands under a per-step trace id derived
+    # from (run, step) alone — so the N per-rank span logs join into ONE
+    # cross-process step trace (obs/traceview.py; tests/test_trace.py
+    # pins the join over two real worker logs).
+    from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
+    from real_time_helmet_detection_tpu.obs.trace import step_context
+    tracer = maybe_tracer()
+    if tracer.enabled:
+        tracer.bind(rank=rank, world=world)
+    sctx = step_context(0, rank=rank, run="ddp-worker") \
+        if tracer.enabled else None
+
     # AOT-compile, BARRIER, then execute: the barrier law (ISSUE 11 —
     # formerly inlined here, now the public parallel.barrier_synced_compile
     # helper). Every compiled program creates its own fresh Gloo context at
@@ -95,9 +109,12 @@ def main() -> None:
     # compiles. process_count()==1 smoke runs skip the barrier inside.
     from real_time_helmet_detection_tpu.parallel import barrier_synced_compile
     compiled = barrier_synced_compile(step, (state, *arrays),
-                                      name="train_step")
-    state, losses = compiled(state, *arrays)
-    jax.block_until_ready(losses["total"])
+                                      name="train_step", tracer=tracer)
+    with tracer.span("scale:step",
+                     ctx=(sctx.child() if sctx is not None else None),
+                     devices=world * ndev_local, world=world):
+        state, losses = compiled(state, *arrays)
+        jax.block_until_ready(losses["total"])
     result = {k: float(v) for k, v in losses.items()}
     result["param0"] = float(
         np.asarray(jax.tree.leaves(state.params)[0]).ravel()[0])

@@ -26,6 +26,7 @@ from real_time_helmet_detection_tpu.runtime import (ChaosInjector,
 from real_time_helmet_detection_tpu.runtime.faults import FLEET_SITES
 from real_time_helmet_detection_tpu.serving import (FleetRouter,
                                                     ServingEngine,
+                                                    SheddedError,
                                                     TenantSheddedError)
 from real_time_helmet_detection_tpu.train import init_variables
 
@@ -95,6 +96,30 @@ def _factory(predict, variables, injector_for=None, **kw):
 def _rows_equal(a, b) -> bool:
     return all(np.array_equal(getattr(a, n), getattr(b, n))
                for n in ("boxes", "classes", "scores", "valid"))
+
+
+def _wait_canary_armed(router, rollout_thread,
+                       timeout_s: float = 120.0) -> None:
+    """Deterministic rollout arming (the ISSUE 14 flake-fix satellite):
+    block until the rollout thread has PICKED + RELOADED its canary —
+    `health()["canary"]` flips non-None only after the swap. The old
+    fixed `time.sleep(0.2)` was box-speed-dependent (2/3 reproduction at
+    r14/r15 HEAD): on a slow box, traffic raced the quiescent-fleet
+    canary pick, the pick could land on the UN-injected replica, the
+    canary watchdog then never saw the injected failures, and the
+    rollout fell through to a window-timeout rollback with no
+    `canary-error-burn` alert. With the poll, the canary identity — and
+    therefore the watchdog's observation sequence — is deterministic
+    regardless of box speed (the no-wall-clock SLO rule applied to the
+    test itself)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline and rollout_thread.is_alive():
+        if router.health()["canary"] is not None:
+            return
+        time.sleep(0.005)
+    if not rollout_thread.is_alive():
+        return  # rollout resolved already; its outcome tells the story
+    raise AssertionError("canary never armed within %.0fs" % timeout_s)
 
 
 def _wait_outstanding_zero(router, timeout_s: float = 60.0) -> None:
@@ -367,7 +392,9 @@ def test_canary_rollback_restores_old_weight_bit_identity(parts):
             new_vars, canary_frac=0.9, window=10_000, timeout_s=120)),
         daemon=True)
     rt.start()
-    time.sleep(0.2)  # rollout picks + reloads the idle canary first
+    # deterministic arming: traffic must not race the quiescent-fleet
+    # canary pick (the r14/r15 flake class — see _wait_canary_armed)
+    _wait_canary_armed(router, rt)
     th = threading.Thread(target=traffic, daemon=True)
     th.start()
     rt.join(timeout=180)
@@ -382,7 +409,16 @@ def test_canary_rollback_restores_old_weight_bit_identity(parts):
     for i, f in inflight:
         try:
             row = f.result(timeout=60)
-        except Exception:  # noqa: BLE001 — would be a lost ack
+        except SheddedError:
+            # admission refused == never ACKNOWLEDGED: while the
+            # rollback drains the canary, the surviving replica can
+            # saturate on a slow box and the fleet correctly sheds at
+            # capacity — counting those as lost acks was the second
+            # box-speed-correlated mode of this test's flake (the
+            # zero-lost-acks invariant is about admitted requests;
+            # serve_bench's canary run accounts sheds the same way)
+            continue
+        except Exception:  # noqa: BLE001 — a genuinely lost ack
             lost += 1
             continue
         assert _rows_equal(row, oracle[i]) or _rows_equal(row,

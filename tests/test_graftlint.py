@@ -133,6 +133,18 @@ AST_CASES = [
      "    return router.submit(image, tenant='bulk')\n"
      "def spawn(factory, rid):\n"
      "    return factory(rid, True)\n"),
+    ("ast/context-free-span",
+     "real_time_helmet_detection_tpu/serving/x.py",
+     # a per-request span emitted without its trace context (ISSUE 14):
+     # the waterfall assembler can never attach it to a request
+     "def shed(tracer, req):\n"
+     "    tracer.event('serve:shed', reason='deadline')\n",
+     # context carried + a lifecycle span (exempt) + fan-in links
+     "def shed(tracer, req, links):\n"
+     "    tracer.event('serve:shed', ctx=req.ctx, reason='deadline')\n"
+     "    with tracer.span('serve:d2h', b=2, links=links):\n"
+     "        pass\n"
+     "    tracer.event('serve:state', **{'from': 'a', 'to': 'b'})\n"),
     ("ast/unbounded-retry", "scripts/x.py",
      # the r2 probe-kill class: swallow + loop forever, no cap, no pause
      "import jax\n"
@@ -181,6 +193,24 @@ def test_engine_bypass_in_fleet_scope_and_allowlist():
             "FleetRouter._dispatch") in ast_rules.FLEET_ENGINE_ALLOW
     assert "scripts/serve_bench.py::make_replica_factory" \
         in ast_rules.FLEET_ENGINE_ALLOW
+
+
+def test_context_free_span_scoped_to_serving():
+    """The trace-context rule polices the serving package only (ISSUE
+    14): the same context-free emission in a script or a train-path
+    module is out of scope (bench sections and train spans have their
+    own taxonomy), and the shipped lifecycle allowlist really names the
+    engine's construction/state spans."""
+    bad = ("def shed(tracer):\n"
+           "    tracer.event('fleet:lost', tenant='bulk')\n")
+    rule = "ast/context-free-span"
+    assert rule in rules_of(ast_rules.lint_source(
+        bad, "real_time_helmet_detection_tpu/serving/fleet.py"))
+    assert rule not in rules_of(ast_rules.lint_source(bad, "scripts/x.py"))
+    assert rule not in rules_of(ast_rules.lint_source(
+        bad, "real_time_helmet_detection_tpu/train.py"))
+    assert {"serve:compile", "serve:state", "fleet:rollout",
+            "fleet:rollback"} <= ast_rules.TRACE_LIFECYCLE_SPANS
 
 
 def test_queue_bypass_scoped_to_chip_scripts():
