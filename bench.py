@@ -729,6 +729,18 @@ def _bench(out: dict, hb) -> None:
             out["donation_ok"] = donation_ok(train_n, (0,), (state, *arrs))
         except Exception as e:  # noqa: BLE001 — never block the bench
             log("donation audit unavailable: %r" % e)
+        try:
+            # lock_audit_clean: the concurrency audit (graftlint layer
+            # 3) self-reported the same way — a chip number produced by
+            # a serving/metrics plane with a known lock bug should say
+            # so in its own JSON line (stdlib ast, ~1 s, no device work)
+            from real_time_helmet_detection_tpu.analysis import (
+                diff_baseline, load_baseline, lock_audit)
+            _lroot = os.path.dirname(os.path.abspath(__file__))
+            out["lock_audit_clean"] = not diff_baseline(
+                lock_audit.audit_repo(_lroot), load_baseline())["new"]
+        except Exception as e:  # noqa: BLE001 — never block the bench
+            log("lock audit unavailable: %r" % e)
         # warmup run consumes (donates) `state`; rebuild for the timed run.
         # The program returns (final state, last loss) so every donated
         # buffer has an output to alias (donation actually elides the

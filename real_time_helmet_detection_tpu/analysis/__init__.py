@@ -13,6 +13,13 @@ invariants instead of remembering them:
 * `trace_audit` — abstract traces of the public entry points via
                   `jax.eval_shape` / `jit(...).lower()`, inspected at the
                   jaxpr + StableHLO level (CPU-only, no TPU contact)
+* `lock_audit`  — the concurrency audit over the threaded serving plane
+                  (lockset inference, lock-order cycles, blocking/
+                  callback-under-lock; stdlib-`ast`, no jax)
+* `interleave`  — the dynamic twin: a seeded deterministic thread
+                  interleaving harness that makes flagged races
+                  PROVABLE (replays the PR 12 health() torn read and
+                  the AB/BA deadlock on concrete schedules)
 
 Findings diff against the committed `analysis/baseline.json`, so the CI
 gate (tests/test_graftlint.py + `scripts/graftlint.py`) is ratchet-only:

@@ -60,6 +60,11 @@ class Batch:
 
 
 _overflow_warned = False
+# pad_boxes runs inside loader worker THREADS (BatchLoader's pool maps
+# dataset reads; DeviceDatasetCache.load_one pads in the pool) — the
+# warn-once check-then-set must be atomic or N workers all warn
+# (lock/unguarded-shared-write — graftlint layer 3)
+_overflow_warn_lock = threading.Lock()
 
 
 def seed_augmentor_for_batch(augmentor, seed: int, epoch: int,
@@ -85,13 +90,16 @@ def seed_augmentor_for_batch(augmentor, seed: int, epoch: int,
 def pad_boxes(boxes: np.ndarray, labels: np.ndarray, max_boxes: int):
     global _overflow_warned
     n = min(len(boxes), max_boxes)
-    if len(boxes) > max_boxes and not _overflow_warned:
-        _overflow_warned = True
-        import warnings
-        warnings.warn(
-            "image with %d boxes exceeds --max-boxes %d; the excess boxes "
-            "lose heatmap/offset supervision (raise --max-boxes)"
-            % (len(boxes), max_boxes), stacklevel=2)
+    if len(boxes) > max_boxes:
+        with _overflow_warn_lock:
+            first = not _overflow_warned
+            _overflow_warned = True
+        if first:  # warn outside the lock: no user I/O under a mutex
+            import warnings
+            warnings.warn(
+                "image with %d boxes exceeds --max-boxes %d; the excess "
+                "boxes lose heatmap/offset supervision (raise --max-boxes)"
+                % (len(boxes), max_boxes), stacklevel=2)
     b = np.zeros((max_boxes, 4), np.float32)
     l = np.zeros((max_boxes,), np.int32)
     v = np.zeros((max_boxes,), bool)

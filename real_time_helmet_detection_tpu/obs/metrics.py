@@ -79,7 +79,8 @@ class Counter:
 
     @property
     def value(self) -> int:
-        return self._v
+        with self._lock:
+            return self._v
 
 
 class Gauge:
@@ -99,7 +100,8 @@ class Gauge:
 
     @property
     def value(self) -> Optional[float]:
-        return self._v
+        with self._lock:
+            return self._v
 
 
 class Histogram:
@@ -113,7 +115,7 @@ class Histogram:
     so means are exact and quantiles clamp to the observed range."""
 
     __slots__ = ("name", "lo", "hi", "sub", "_buckets", "count", "total",
-                 "min", "max", "_lock", "_noct")
+                 "min", "max", "_lock", "_noct", "_nbuckets")
 
     # value domain defaults cover ~1 us .. ~1e6 (unit-agnostic: callers
     # pick one unit per metric — the repo convention is milliseconds for
@@ -132,7 +134,11 @@ class Histogram:
         self.hi = float(hi)
         self.sub = int(sub)
         self._noct = int(math.ceil(math.log2(self.hi / self.lo)))
-        self._buckets = [0] * (self._noct * self.sub + 2)
+        # layout constant (bucket list length never changes): _index /
+        # _bucket_mid read THIS, not len(_buckets), so the hot index
+        # computation needs no lock
+        self._nbuckets = self._noct * self.sub + 2
+        self._buckets = [0] * self._nbuckets
         self.count = 0
         self.total = 0.0
         self.min: Optional[float] = None
@@ -145,9 +151,9 @@ class Histogram:
         if not (v >= self.lo):      # also catches NaN
             return 0
         if v >= self.hi:
-            return len(self._buckets) - 1
+            return self._nbuckets - 1
         i = int(math.log2(v / self.lo) * self.sub)
-        return max(1, min(len(self._buckets) - 2, 1 + i))
+        return max(1, min(self._nbuckets - 2, 1 + i))
 
     def _bucket_mid(self, i: int) -> float:
         """Geometric midpoint of bucket i (underflow -> lo, overflow ->
@@ -155,7 +161,7 @@ class Histogram:
         min/max."""
         if i <= 0:
             return self.lo
-        if i >= len(self._buckets) - 1:
+        if i >= self._nbuckets - 1:
             return self.hi
         return self.lo * 2.0 ** ((i - 1 + 0.5) / self.sub)
 
@@ -197,25 +203,33 @@ class Histogram:
 
     # -- read path ---------------------------------------------------------
 
+    def _quantile_unlocked(self, q: float):  # guarded-by: _lock
+        """Quantile body; callers (quantile/digest) hold `_lock` — split
+        out so digest() can read count/mean/p50/p99/max in ONE coherent
+        lock window instead of stitching per-field acquisitions (the
+        same torn-digest class as the PR 12 engine `health()` bug)."""
+        if self.count == 0:
+            return None
+        rank = min(self.count - 1,
+                   max(0, int(round(float(q) * (self.count - 1)))))
+        seen = 0
+        for i, n in enumerate(self._buckets):
+            seen += n
+            if seen > rank:
+                mid = self._bucket_mid(i)
+                return max(self.min, min(self.max, mid))
+        return self.max  # unreachable unless counts were torn
+
     def quantile(self, q: float) -> Optional[float]:
         """Nearest-rank quantile at bucket resolution (geometric bucket
         midpoint, clamped to exact min/max). None when empty."""
         with self._lock:
-            if self.count == 0:
-                return None
-            rank = min(self.count - 1,
-                       max(0, int(round(float(q) * (self.count - 1)))))
-            seen = 0
-            for i, n in enumerate(self._buckets):
-                seen += n
-                if seen > rank:
-                    mid = self._bucket_mid(i)
-                    return max(self.min, min(self.max, mid))
-            return self.max  # unreachable unless counts were torn
+            return self._quantile_unlocked(q)
 
     @property
     def mean(self) -> Optional[float]:
-        return self.total / self.count if self.count else None
+        with self._lock:
+            return self.total / self.count if self.count else None
 
     def snapshot(self) -> Dict:
         with self._lock:
@@ -235,13 +249,21 @@ class Histogram:
         return h
 
     def digest(self) -> Dict:
-        """The compact human/health() form: count, mean, p50/p99, max."""
-        p50, p99 = self.quantile(0.50), self.quantile(0.99)
-        return {"count": self.count,
-                "mean": None if self.mean is None else round(self.mean, 4),
+        """The compact human/health() form: count, mean, p50/p99, max —
+        read under ONE lock acquisition so the digest is internally
+        consistent (count matches the distribution the quantiles were
+        scanned from; pinned by tests/test_lock_audit.py)."""
+        with self._lock:
+            count = self.count
+            mean = self.total / count if count else None
+            p50 = self._quantile_unlocked(0.50)
+            p99 = self._quantile_unlocked(0.99)
+            mx = self.max
+        return {"count": count,
+                "mean": None if mean is None else round(mean, 4),
                 "p50": None if p50 is None else round(p50, 4),
                 "p99": None if p99 is None else round(p99, 4),
-                "max": self.max}
+                "max": mx}
 
 
 class MetricsRegistry:
@@ -299,12 +321,22 @@ class MetricsRegistry:
     def digest(self, prefix: str = "") -> Dict:
         """Compact view for health()/reports: counters + gauges verbatim,
         histograms as count/mean/p50/p99/max digests; optionally filtered
-        to names starting with `prefix`."""
-        snap_c = {n: c.value for n, c in sorted(self._counters.items())
+        to names starting with `prefix`.
+
+        The handle dicts are COPIED under the registry lock first
+        (snapshot()'s discipline): iterating them live races concurrent
+        handle creation — a serving thread minting a new tenant counter
+        mid-digest was a `RuntimeError: dictionary changed size` away
+        from killing a health() call."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._hists)
+        snap_c = {n: c.value for n, c in sorted(counters.items())
                   if n.startswith(prefix)}
-        snap_g = {n: g.value for n, g in sorted(self._gauges.items())
+        snap_g = {n: g.value for n, g in sorted(gauges.items())
                   if n.startswith(prefix)}
-        snap_h = {n: h.digest() for n, h in sorted(self._hists.items())
+        snap_h = {n: h.digest() for n, h in sorted(hists.items())
                   if n.startswith(prefix)}
         return {"counters": snap_c, "gauges": snap_g, "histograms": snap_h}
 
@@ -376,10 +408,12 @@ class MetricsWriter:
         was written. Never raises into the instrumented job: an export
         failure disables the writer (half-dead appends help nobody —
         obs/spans.py's rule)."""
-        if not self.enabled:
-            return False
         now = time.monotonic()
         with self._lock:
+            # `enabled` is checked (and on failure flipped) under the
+            # writer lock: an unlocked fast-path read raced the disable
+            if not self.enabled:
+                return False
             if not force and now - self._last_flush < self.period_s:
                 return False
             self._last_flush = now
@@ -402,12 +436,15 @@ class MetricsWriter:
 
     def close(self) -> None:
         self.maybe_flush(force=True)
-        if self._f is not None:
+        with self._lock:
+            # swap under the lock, close outside it: a concurrent
+            # maybe_flush either finished before the swap or finds None
+            f, self._f = self._f, None
+        if f is not None:
             try:
-                self._f.close()
+                f.close()
             except OSError:
                 pass
-            self._f = None
 
 
 def maybe_writer(path: Optional[str] = None, env: Optional[dict] = None,

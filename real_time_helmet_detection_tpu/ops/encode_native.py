@@ -46,8 +46,10 @@ def _build() -> bool:
 def _load() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native library; None if unavailable."""
     global _lib, _load_failed
-    if _lib is not None or _load_failed:
-        return _lib
+    # double-checked fast path: a stale read only costs re-entering the
+    # locked slow path below, which re-checks under _lock
+    if _lib is not None or _load_failed:  # lock-free: DCL fast path
+        return _lib  # lock-free: DCL fast path
     with _lock:
         if _lib is not None or _load_failed:
             return _lib

@@ -197,6 +197,11 @@ class HangWatchdog:
         import threading
         self.warn_seconds = float(warn_seconds)
         self.where = where
+        # _mu guards the beat state shared with the watchdog thread
+        # (_beat/_label/_warned/_paused/_status_fn): beat() racing _run()
+        # could lose a pause flag or re-arm a warning mid-print
+        # (lock/unguarded-shared-write — graftlint layer 3)
+        self._mu = threading.Lock()
         self._beat = time.monotonic()  # immune to wall-clock NTP steps
         self._label = "start"
         self._stop = threading.Event()
@@ -217,12 +222,14 @@ class HangWatchdog:
         ages (`ProcessBatchLoader.worker_status`), so a stall can be
         attributed to the input pipeline vs the device transport at a
         glance."""
-        self._status_fn = fn
+        with self._mu:
+            self._status_fn = fn
 
     def beat(self, label: str) -> None:
-        self._beat = time.monotonic()
-        self._label = label
-        self._warned = False
+        with self._mu:
+            self._beat = time.monotonic()
+            self._label = label
+            self._warned = False
         if self._file is not None:
             self._file.beat(label)
 
@@ -230,39 +237,48 @@ class HangWatchdog:
         """Suspend warnings across a known-slow operation (checkpoint save:
         a full-state device_get can legitimately take minutes on a slow
         transport). A point beat only resets the clock; pause holds it."""
-        self._paused = True
-        self._label = label
+        with self._mu:
+            self._paused = True
+            self._label = label
         if self._file is not None:
             self._file.beat("paused: %s" % label)
 
     def resume(self, label: str) -> None:
-        self._paused = False
+        with self._mu:
+            self._paused = False
         self.beat(label)
 
     def _run(self) -> None:
         import faulthandler
         import sys
         while not self._stop.wait(min(30.0, self.warn_seconds / 4)):
-            stalled = time.monotonic() - self._beat
-            if self._paused and self._file is not None:
+            # snapshot + decide under the lock; warn (print, status
+            # callback, stack dump) OUTSIDE it — slow I/O must not stall
+            # a beating trainer on the mutex
+            with self._mu:
+                stalled = time.monotonic() - self._beat
+                paused, label = self._paused, self._label
+                status_fn = self._status_fn
+                fire = (stalled > self.warn_seconds and not self._warned
+                        and not paused)
+                if fire:
+                    self._warned = True
+            if paused and self._file is not None:
                 # a paused watchdog is a process that DECLARED itself busy,
                 # not a dead one: keep the external heartbeat alive so the
                 # supervisor's stale-kill deadline only fires on real hangs
-                self._file.beat("paused: %s" % self._label)
-            if stalled > self.warn_seconds and not self._warned \
-                    and not self._paused:
-                self._warned = True
+                self._file.beat("paused: %s" % label)
+            if fire:
                 extra = ""
-                if self._status_fn is not None:
+                if status_fn is not None:
                     try:
-                        extra = " | " + str(self._status_fn())
+                        extra = " | " + str(status_fn())
                     except Exception:  # noqa: BLE001 — status is best-effort
                         pass
                 print("%s: WATCHDOG: no %s progress for %.0fs (last: %s) — "
                       "the device transport may be wedged; if this "
                       "persists, kill and resume from the last checkpoint%s"
-                      % (time.ctime(), self.where, stalled, self._label,
-                         extra),
+                      % (time.ctime(), self.where, stalled, label, extra),
                       flush=True)
                 try:  # where is the main thread stuck? (needs a real fd —
                     faulthandler.dump_traceback(file=sys.__stderr__)

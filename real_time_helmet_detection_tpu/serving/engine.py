@@ -520,7 +520,10 @@ class ServingEngine:
 
     @property
     def state(self) -> str:
-        return self._state
+        # _state is _lock-guarded everywhere it is written; an unlocked
+        # read here was the one hole (lock/unguarded-shared-write)
+        with self._lock:
+            return self._state
 
     def degrade(self, reason: str) -> None:
         """External DEGRADED flip (the SLO watchdog's lever, ISSUE 10):

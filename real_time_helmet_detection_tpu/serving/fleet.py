@@ -411,6 +411,7 @@ class FleetRouter:
         with self._lock:
             reps = list(self._replicas)
             canary = self._canary
+            canary_frac = self._canary_frac
             tenants = {t.name: {"budget": t.budget,
                                 "outstanding": t.outstanding,
                                 "penalty": t.penalty,
@@ -428,7 +429,7 @@ class FleetRouter:
             "tenant_tiers": dict(self._tenant_tiers),
             "canary": (None if canary is None
                        else {"rid": canary.rid,
-                             "frac": self._canary_frac}),
+                             "frac": canary_frac}),
             "counters": {("fleet." + k): c.value
                          for k, c in sorted(self._mc.items())},
             "alerts": list(self._watchdog.alerts),
@@ -439,7 +440,9 @@ class FleetRouter:
 
     # ---- tenant admission ------------------------------------------------
 
-    def _tenant(self, name: str) -> _Tenant:
+    def _tenant(self, name: str) -> _Tenant:  # guarded-by: _lock
+        # every caller (submit/_shed/_on_replica_done) holds the router
+        # lock — the call-graph fact the annotation states for the audit
         t = self._tenants.get(name)
         if t is None:
             t = self._tenants[name] = _Tenant(name, self._default_budget,
@@ -447,7 +450,7 @@ class FleetRouter:
             self._watchdog.rules.extend(self._make_tenant_rules(name))
         return t
 
-    def _tenant_alerts(self, fired: List[Dict]) -> None:
+    def _tenant_alerts(self, fired: List[Dict]) -> None:  # guarded-by: _lock
         """Map fired `tenant-<t>-*` alerts to penalty boxes (called with
         the router lock HELD)."""
         for alert in fired:
@@ -632,7 +635,8 @@ class FleetRouter:
             self._shed(req, "deadline", err)
             return
         # replica-level failure: re-dispatch within budget and deadline
-        closing = self._closing
+        with self._lock:
+            closing = self._closing
         if (not closing) and req.attempts < self._max_redispatch:
             req.attempts += 1
             fut.redispatches += 1
@@ -679,7 +683,9 @@ class FleetRouter:
         -> cheap tier, flagged -> quality — the ROADMAP interplay); a
         tenant with no policy routes fleet-wide as before."""
         del block  # API-compat only: a router shed is always immediate
-        if self._closing:
+        with self._lock:
+            closing = self._closing
+        if closing:
             raise EngineClosedError("fleet router closed")
         tenant = _sanitize_tenant(tenant)
         if tier is None:

@@ -7,27 +7,44 @@ CLAUDE.md) is checked mechanically BEFORE a chip-second is spent. The
 reference repo has nothing comparable (its only check is a manual module
 self-test, ref /root/reference/hourglass.py:241-256).
 
-Two layers (real_time_helmet_detection_tpu/analysis/):
+Three layers (real_time_helmet_detection_tpu/analysis/):
 
 * AST convention rules (`ast_rules.py`, stdlib-only)  — always run
 * trace audit (`trace_audit.py`, jaxpr + StableHLO over the public entry
   points) — CPU-only, zero TPU contact; skip with `--ast-only`
+* concurrency audit (`lock_audit.py`, stdlib-only) — lockset inference,
+  lock-order cycles, blocking/callback-under-lock over the threaded
+  serving plane; its dynamic twin (`interleave.py`) replays seeded
+  thread schedules so flagged races are PROVABLE (the selfcheck
+  reproduces the PR 12 health() torn read and the AB/BA deadlock on
+  seeded schedules, and certifies the fixed shapes clean)
 
 Findings diff against the committed `analysis/baseline.json` (ratchet:
-new findings fail, baselined entries are individually justified). Run it
-before enqueueing chip jobs; CI runs it in the smoke tier
-(tests/test_graftlint.py).
+new findings fail, baselined entries are individually justified; the
+baseline is EMPTY — findings get fixed or annotated, not grandfathered).
+Run it before enqueueing chip jobs; CI runs it in the smoke tier
+(tests/test_graftlint.py, tests/test_lock_audit.py).
 
 Usage:
 
     python scripts/graftlint.py                  # full run, gate on new
     python scripts/graftlint.py --ast-only       # skip the trace layer
+    python scripts/graftlint.py --changed HEAD   # ~1 s pre-commit loop:
+                                                 # AST+lock layers over
+                                                 # files changed vs a ref
+    python scripts/graftlint.py --format github  # ::error annotations
+                                                 # (+ the JSON line LAST)
     python scripts/graftlint.py --write-baseline # reset the ratchet
     python scripts/graftlint.py --selfcheck      # prove every rule fires
                                                  # on seeded fixtures
+                                                 # (--ast-only skips the
+                                                 # slow trace fixtures)
 
 Prints ONE JSON line (repo convention); findings detail goes to stderr.
-Exit 0 = clean vs baseline, 1 = new findings (or selfcheck failure).
+`--format github` is the documented exception: GitHub only parses
+workflow commands from stdout, so annotation lines precede the final
+JSON line there. Exit 0 = clean vs baseline, 1 = new findings (or
+selfcheck failure).
 """
 
 from __future__ import annotations
@@ -44,10 +61,34 @@ sys.path.insert(0, REPO)
 from real_time_helmet_detection_tpu.analysis import (  # noqa: E402
     Finding, diff_baseline, load_baseline, write_baseline)
 from real_time_helmet_detection_tpu.analysis import ast_rules  # noqa: E402
+from real_time_helmet_detection_tpu.analysis import interleave  # noqa: E402
+from real_time_helmet_detection_tpu.analysis import lock_audit  # noqa: E402
 
 
 def log(msg: str) -> None:
     print("[graftlint] %s" % msg, file=sys.stderr, flush=True)
+
+
+def changed_files(ref: str):
+    """Repo-relative .py files changed vs `ref` (working tree diff,
+    staged + unstaged — the pre-commit view), intersected with the lint
+    scope so deleted/out-of-scope paths drop out."""
+    import subprocess
+    r = subprocess.run(["git", "diff", "--name-only", "-z", ref, "--"],
+                       capture_output=True, text=True, cwd=REPO)
+    if r.returncode != 0:
+        raise SystemExit("graftlint --changed: git diff vs %r failed: %s"
+                         % (ref, r.stderr.strip()[:200]))
+    changed = {p for p in r.stdout.split("\0") if p.endswith(".py")}
+    return sorted(changed & set(ast_rules.repo_files(REPO)))
+
+
+def github_annotations(findings) -> list:
+    """GitHub Actions workflow-command lines for a finding list."""
+    return ["::error file=%s,line=%d,title=%s::%s"
+            % (f.path, max(1, f.line), f.rule,
+               f.message.replace("\n", " "))
+            for f in findings]
 
 
 def _force_cpu() -> None:
@@ -60,17 +101,38 @@ def _force_cpu() -> None:
 
 def run_lint(args) -> int:
     t0 = time.time()
-    findings = ast_rules.lint_repo(REPO)
-    log("ast layer: %d finding(s) over %d file(s)"
-        % (len(findings), len(ast_rules.repo_files(REPO))))
+    only = None
+    if args.changed:
+        only = changed_files(args.changed)
+        log("changed mode vs %s: %d file(s) in scope"
+            % (args.changed, len(only)))
+        findings = []
+        for rel in only:
+            with open(os.path.join(REPO, rel)) as f:
+                findings += ast_rules.lint_source(f.read(), rel)
+        log("ast layer: %d finding(s) over %d changed file(s)"
+            % (len(findings), len(only)))
+    else:
+        findings = ast_rules.lint_repo(REPO)
+        log("ast layer: %d finding(s) over %d file(s)"
+            % (len(findings), len(ast_rules.repo_files(REPO))))
+    # layer 3: concurrency audit — per-file rules follow the changed set;
+    # the lock-order graph is ALWAYS global (an edge added in a changed
+    # file can close a cycle through an untouched one)
+    lfind = lock_audit.audit_repo(REPO, only=only)
+    log("lock layer: %d finding(s)" % len(lfind))
+    findings += lfind
     trace_ran = False
-    if not args.ast_only:
+    if not args.ast_only and not args.changed:
         _force_cpu()
         from real_time_helmet_detection_tpu.analysis import trace_audit
         tfind = trace_audit.audit_repo_entry_points(lower=not args.no_lower)
         log("trace layer: %d finding(s)" % len(tfind))
         findings += tfind
         trace_ran = True
+    elif args.changed and not args.ast_only:
+        log("trace layer skipped in --changed mode (the full run stays "
+            "the gate)")
 
     if args.write_baseline:
         baseline = load_baseline()
@@ -92,11 +154,19 @@ def run_lint(args) -> int:
         log("stale baseline entry (fixed — drop it): %s" % k)
 
     ok = not d["new"]
+    if args.format == "github":
+        # the documented ONE-JSON-line exception: GitHub parses workflow
+        # commands from stdout only, so annotations precede the (LAST)
+        # JSON line
+        for ln in github_annotations(d["new"]):
+            print(ln)
     print(json.dumps({
         "tool": "graftlint", "ok": ok, "findings": len(findings),
         "new": len(d["new"]), "baselined": len(d["baselined"]),
         "stale_baseline": len(d["stale"]), "by_rule": by_rule,
-        "trace_layer": trace_ran, "elapsed_s": round(time.time() - t0, 1),
+        "trace_layer": trace_ran,
+        "changed": args.changed or None,
+        "elapsed_s": round(time.time() - t0, 1),
         "new_keys": sorted(f.key for f in d["new"])[:20],
     }))
     sys.stdout.flush()
@@ -332,6 +402,199 @@ SERVING_FIXTURES = {
 }
 
 
+LOCK_FIXTURES = {
+    # rule-short-name: (bad source, good source) — linted standalone via
+    # lock_audit.audit_source (layer 3)
+    "unguarded-shared-write": (
+        # the PR 12 class: state written under the lock, read outside it
+        "import threading\n"
+        "class Eng:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._state = 'serving'\n"
+        "    def set_state(self, s):\n"
+        "        with self._lock:\n"
+        "            self._state = s\n"
+        "    def state(self):\n"
+        "        return self._state\n",
+        # the fix: every touch inside a window
+        "import threading\n"
+        "class Eng:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._state = 'serving'\n"
+        "    def set_state(self, s):\n"
+        "        with self._lock:\n"
+        "            self._state = s\n"
+        "    def state(self):\n"
+        "        with self._lock:\n"
+        "            return self._state\n",
+    ),
+    "order-cycle": (
+        # AB in one method, BA in another: deadlock potential (the
+        # interleave harness drives this exact shape into the detected
+        # deadlock — see the dynamic checks below)
+        "import threading\n"
+        "class X:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def m1(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def m2(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n",
+        # ONE global order
+        "import threading\n"
+        "class X:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def m1(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def m2(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n",
+    ),
+    "blocking-call-under-lock": (
+        # a batched D2H inside the mutex: every submitter stalls ~70 ms
+        "import threading, jax\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.out = None\n"
+        "    def flush(self, dev):\n"
+        "        with self._lock:\n"
+        "            self.out = jax.device_get(dev)\n",
+        # fetch outside, publish under the lock
+        "import threading, jax\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.out = None\n"
+        "    def flush(self, dev):\n"
+        "        host = jax.device_get(dev)\n"
+        "        with self._lock:\n"
+        "            self.out = host\n",
+    ),
+    "callback-under-lock": (
+        # user code inside the critical section: re-entry deadlocks
+        "import threading\n"
+        "class F:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cb = None\n"
+        "    def set_cb(self, fn):\n"
+        "        with self._lock:\n"
+        "            self._cb = fn\n"
+        "    def fire(self):\n"
+        "        with self._lock:\n"
+        "            cb = self._cb\n"
+        "            cb(self)\n",
+        # the ServeFuture._run_callback shape: snapshot, release, fire
+        "import threading\n"
+        "class F:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cb = None\n"
+        "    def set_cb(self, fn):\n"
+        "        with self._lock:\n"
+        "            self._cb = fn\n"
+        "    def fire(self):\n"
+        "        with self._lock:\n"
+        "            cb = self._cb\n"
+        "        cb(self)\n",
+    ),
+}
+
+
+def _selfcheck_lock(check) -> None:
+    spath = ast_rules.SERVING_PREFIX + "lock_fixture_%s.py"
+    for short, (bad, good) in LOCK_FIXTURES.items():
+        rule = "lock/" + short
+        bad_f = lock_audit.audit_source(bad, spath % "bad")
+        good_f = lock_audit.audit_source(good, spath % "good")
+        check("%s fires on bad fixture" % rule,
+              any(f.rule == rule for f in bad_f))
+        check("%s silent on good fixture" % rule,
+              not any(f.rule == rule for f in good_f))
+    # the annotation convention: a guarded-by'd caller-holds-the-lock
+    # scope and a lock-free'd intentional read both go silent
+    bad, _good = LOCK_FIXTURES["unguarded-shared-write"]
+    ann = bad.replace("    def state(self):",
+                      "    def state(self):  # lock-free: GIL-atomic "
+                      "single-field read")
+    check("lock-free annotation honored",
+          not lock_audit.audit_source(ann, spath % "ann"))
+    guarded = (
+        "import threading\n"
+        "class R:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._tenants = {}\n"
+        "    def _tenant(self, name):  # guarded-by: _lock\n"
+        "        self._tenants[name] = 1\n"
+        "    def submit(self, name):\n"
+        "        with self._lock:\n"
+        "            self._tenant(name)\n")
+    check("guarded-by annotation honored",
+          not lock_audit.audit_source(guarded, spath % "gb"))
+    # thread-shared state with no lock at all (the HangWatchdog class)
+    threaded = (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._warned = False\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "    def _run(self):\n"
+        "        self._warned = True\n"
+        "    def beat(self):\n"
+        "        self._warned = False\n")
+    check("lock/unguarded-shared-write fires on lockless thread share",
+          any(f.rule == "lock/unguarded-shared-write"
+              for f in lock_audit.audit_source(threaded, spath % "thr")))
+    # graftlint: off= suppression works on the lock layer too
+    sup = bad.replace("        return self._state",
+                      "        return self._state  "
+                      "# graftlint: off=unguarded-shared-write")
+    check("lock layer honors graftlint: off=",
+          not lock_audit.audit_source(sup, spath % "sup"))
+
+    # ---- dynamic half: seeded interleaving proofs (CPU, milliseconds)
+    torn = interleave.find_torn_read(fixed=False)
+    check("interleave reproduces the PR 12 health() torn read",
+          torn is not None)
+    if torn is not None:
+        sched = interleave.Scheduler(torn["seed"])
+        fx = interleave.TornHealthFixture(sched, fixed=False)
+        observed = []
+
+        def reader():
+            for _ in range(3):
+                observed.append(fx.health())
+
+        def writer():
+            for _ in range(2):
+                fx.reload()
+
+        sched.run([reader, writer])
+        check("torn-read schedule replays deterministically (seed %d)"
+              % torn["seed"], sched.trace == torn["trace"])
+    check("single-window health() certified clean over the seed sweep",
+          interleave.find_torn_read(fixed=True) is None)
+    dl = interleave.find_deadlock(ordered=False)
+    check("interleave drives the AB/BA cycle into a detected deadlock",
+          dl is not None and len(dl["waiting"]) == 2)
+    check("single-order twin never deadlocks over the seed sweep",
+          interleave.find_deadlock(ordered=True) is None)
+
+
 def _selfcheck_ast(check) -> None:
     for short, (bad, good) in AST_FIXTURES.items():
         rule = "ast/" + short
@@ -517,7 +780,7 @@ def _selfcheck_trace(check) -> None:
     check("fused-epilogue predict audits clean", not ef)
 
 
-def selfcheck() -> int:
+def selfcheck(ast_only: bool = False) -> int:
     t0 = time.time()
     failures = []
 
@@ -528,11 +791,13 @@ def selfcheck() -> int:
             failures.append(name)
 
     _selfcheck_ast(check)
-    _selfcheck_trace(check)
+    _selfcheck_lock(check)
+    if not ast_only:
+        _selfcheck_trace(check)
 
     ok = not failures
     print(json.dumps({"tool": "graftlint", "selfcheck": True, "ok": ok,
-                      "failures": failures,
+                      "failures": failures, "trace_layer": not ast_only,
                       "elapsed_s": round(time.time() - t0, 1)}))
     sys.stdout.flush()
     return 0 if ok else 1
@@ -550,10 +815,21 @@ def main(argv=None) -> int:
                         "from the current findings (existing "
                         "justifications are carried over by key)")
     p.add_argument("--selfcheck", action="store_true",
-                   help="prove every rule fires on seeded fixtures")
+                   help="prove every rule fires on seeded fixtures "
+                        "(with --ast-only: skip the slow trace fixtures "
+                        "— the fast pre-commit proof)")
+    p.add_argument("--changed", metavar="REF", default=None,
+                   help="incremental mode: AST+lock layers over files "
+                        "changed vs REF only (~1 s); the trace layer and "
+                        "--write-baseline need the full run")
+    p.add_argument("--format", choices=("text", "github"), default="text",
+                   help="'github' emits ::error annotations for new "
+                        "findings before the final JSON line")
     args = p.parse_args(argv)
     if args.selfcheck:
-        return selfcheck()
+        return selfcheck(ast_only=args.ast_only)
+    if args.changed and args.write_baseline:
+        p.error("--write-baseline needs the full run, not --changed")
     return run_lint(args)
 
 

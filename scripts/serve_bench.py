@@ -898,6 +898,17 @@ def selfcheck() -> int:
         if not cond:
             failures.append(name)
 
+    # graftlint layer-3 gate (trace-audit-gate pattern): the threaded
+    # engine/fleet plane this selfcheck is about to exercise must be
+    # lock-audit clean FIRST — proving behavior on top of a known lock
+    # bug proves nothing (stdlib ast, ~1 s)
+    from real_time_helmet_detection_tpu.analysis import (diff_baseline,
+                                                         load_baseline,
+                                                         lock_audit)
+    check("lock audit clean (graftlint layer 3)",
+          not diff_baseline(lock_audit.audit_repo(REPO),
+                            load_baseline())["new"])
+
     ns = argparse.Namespace(imsize=64, inch=8, topk=16, amp=False,
                             infer_dtype="bf16", buckets=(1, 2, 4),
                             seed=7, pool=12)
