@@ -79,11 +79,12 @@ DEFAULT_HBM = 819e9  # v5e
 # and runner_drive.py (they diverged in r5: mfu_breakdown defaulted to r05
 # while the rest stayed at r04, scattering same-round artifacts — ADVICE
 # r5 #3); bump it here when a new round starts, or override per-run with
-# $GRAFT_ROUND. r15 = the latency-tier round (ISSUE 13: Lighter-Hourglass
-# variants, arch_grid search, distillation, the per-tier Pareto frontier
-# in quality_matrix + the perfgate `quality` class); earlier rounds'
-# artifact dirs are committed history and must not be overwritten.
-GRAFT_ROUND_DEFAULT = "r15"
+# $GRAFT_ROUND. r16 = the cascade-serving round (ISSUE 16: edge-first
+# fleet routing with confidence-gated escalation, quality_matrix
+# --cascade calibration + serve_bench --cascade goodput evidence);
+# earlier rounds' artifact dirs are committed history and must not be
+# overwritten.
+GRAFT_ROUND_DEFAULT = "r16"
 
 # The arch fields every bench line carries (ISSUE 13): the residual-block
 # variant, stack count, width and the resolved tier name. Pre-tier lines
@@ -100,6 +101,20 @@ def bench_arch_of(rec: dict) -> dict:
     pre-tier lines parse as the flagship defaults (regression-tested —
     the ONE-line contract and every committed trajectory keep reading)."""
     return {k: rec.get(k, v) for k, v in ARCH_DEFAULTS.items()}
+
+
+# The cascade fields (ISSUE 16): whether the benched predict carried the
+# in-jit confidence summary, and the fraction of the bench batch that
+# would escalate at the resolved threshold. Pre-cascade lines lack them —
+# `bench_cascade_of` parses ANY line into the full dict, defaulting to
+# cascade-off (same back-compat contract as bench_arch_of).
+CASCADE_DEFAULTS = {"cascade": False, "escalation_rate": None}
+
+
+def bench_cascade_of(rec: dict) -> dict:
+    """The (cascade, escalation_rate) of a bench JSON line; pre-cascade
+    lines parse as cascade-off (regression-tested like the arch fields)."""
+    return {k: rec.get(k, v) for k, v in CASCADE_DEFAULTS.items()}
 
 # v5e int8 MXU peak (2x the bf16 peak — jax-ml scaling-book): the
 # denominator for int8-path MFU and the hardware case for --infer-dtype
@@ -261,7 +276,10 @@ def find_last_tpu_result(repo_root: str | None = None) -> dict | None:
             "device_count", "mesh_shape",
             # arch fields (ISSUE 13): absent on pre-tier lines — the
             # consumer parses via bench_arch_of (flagship defaults)
-            "variant", "num_stack", "width", "tier")
+            "variant", "num_stack", "width", "tier",
+            # cascade fields (ISSUE 16): absent on pre-cascade lines —
+            # the consumer parses via bench_cascade_of (cascade-off)
+            "cascade", "escalation_rate")
     out.update({k: rec[k] for k in keep if k in rec})
     return out
 
@@ -531,7 +549,32 @@ def _bench(out: dict, hb) -> None:
 
     params, batch_stats = init_variables(model, jax.random.key(0), imsize)
     variables = {"params": params, "batch_stats": batch_stats}
-    predict = make_predict_fn(model, cfg)
+    # --cascade / BENCH_CASCADE=1 (ISSUE 16): the timed predict carries the
+    # in-jit confidence summary (ops/decode.confidence_summary riding the
+    # detection block — the zero-extra-D2H contract means `value` should
+    # match the plain program within noise), and the line reports the
+    # fraction of the bench batch that would escalate at the resolved
+    # threshold ($BENCH_CASCADE_THRESHOLD, else the newest committed
+    # calibration artifact via config.cascade_overrides). Off = the exact
+    # pre-PR program; pre-cascade lines parse via bench_cascade_of.
+    cascade_on = (os.environ.get("BENCH_CASCADE") == "1"
+                  or "--cascade" in sys.argv)
+    out["cascade"] = cascade_on
+    predict = make_predict_fn(model, cfg, cascade_summary=cascade_on)
+    if cascade_on:
+        try:
+            th_env = os.environ.get("BENCH_CASCADE_THRESHOLD")
+            if th_env is not None:
+                casc_th = float(th_env)
+            else:
+                from real_time_helmet_detection_tpu.config import (
+                    cascade_overrides)
+                casc_th = float(cascade_overrides()["cascade_threshold"])
+            out["cascade_threshold"] = casc_th
+        except FileNotFoundError:
+            casc_th = None
+            log("cascade: no calibration artifact and no "
+                "$BENCH_CASCADE_THRESHOLD; escalation_rate omitted")
 
     def make_predict_chain(pred, n):
         """N sequential predicts in ONE program; each iteration's input
@@ -582,6 +625,23 @@ def _bench(out: dict, hb) -> None:
     except Exception as e:  # noqa: BLE001
         log("inference bench failed: %r" % e)
     hb.beat("inference section done")
+
+    # --- cascade escalation rate (--cascade) ------------------------------
+    # One dispatch + one fetch of the confidence leaf on a fresh bench
+    # batch — OFF the timed path (the timed chain above already carried
+    # the summary computation and fetched only its scalar).
+    if cascade_on and casc_th is not None:
+        try:
+            cimgs = jnp.asarray(rng.standard_normal(
+                (batch, imsize, imsize, 3)).astype(np.float32))
+            conf = np.asarray(predict(variables, cimgs).confidence)
+            out["escalation_rate"] = round(
+                float(np.mean(conf < casc_th)), 4)
+            log("cascade: escalation rate %.3f at threshold %.4f (batch %d)"
+                % (out["escalation_rate"], casc_th, batch))
+        except Exception as e:  # noqa: BLE001
+            log("cascade escalation-rate probe failed: %r" % e)
+        hb.beat("cascade section done")
 
     # --- batch-1 latency ---------------------------------------------------
     try:

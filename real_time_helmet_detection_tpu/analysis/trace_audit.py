@@ -287,7 +287,8 @@ def _tiny_train_parts(remat: str = "none", param_policy: str = "fp32",
 
 def _tiny_predict_parts(normalize: Optional[str] = None,
                         epilogue: str = "auto",
-                        arch: Optional[dict] = None):
+                        arch: Optional[dict] = None,
+                        cascade_summary: bool = False):
     import jax
     import numpy as np
 
@@ -302,7 +303,8 @@ def _tiny_predict_parts(normalize: Optional[str] = None,
     params, batch_stats = init_variables(model, jax.random.key(0),
                                          _TINY["imsize"])
     variables = {"params": params, "batch_stats": batch_stats}
-    predict = make_predict_fn(model, cfg, normalize=normalize)
+    predict = make_predict_fn(model, cfg, normalize=normalize,
+                              cascade_summary=cascade_summary)
     if normalize:
         images = np.zeros((_BATCH, _TINY["imsize"], _TINY["imsize"], 3),
                           np.uint8)
@@ -512,6 +514,29 @@ def audit_repo_entry_points(lower: bool = True) -> List[Finding]:
         findings.append(Finding(
             rule="trace/trace-failure", path="<predict_epilogue_fused>",
             context="predict_epilogue_fused",
+            message="entry construction failed: %s: %s"
+                    % (type(e).__name__,
+                       (str(e).splitlines() or ["?"])[0][:200])))
+
+    try:
+        # the cascade-summary predict (ISSUE 16): the edge tier's serving
+        # program with the in-jit confidence summary riding the detection
+        # block (ops/decode.confidence_summary over the fixed-shape
+        # masked Detections — the FleetRouter's escalation signal). Its
+        # trace must stay exactly as clean as the plain edge predict:
+        # dynamic shapes, f64 leaks or retrace instability here would
+        # recompile on the cascade hot path
+        casc_arch = dict(TIER_AUDIT[0][1])
+        predict_c, variables_c, images_c = _tiny_predict_parts(
+            arch=casc_arch, cascade_summary=True)
+        findings += audit_entry(
+            lambda v, im: predict_c(v, im), (variables_c, images_c),
+            "predict_cascade_summary[tier=edge]", lower=lower)
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            rule="trace/trace-failure",
+            path="<predict_cascade_summary[tier=edge]>",
+            context="predict_cascade_summary[tier=edge]",
             message="entry construction failed: %s: %s"
                     % (type(e).__name__,
                        (str(e).splitlines() or ["?"])[0][:200])))

@@ -30,6 +30,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import math
 import os
 import random
 from dataclasses import dataclass, field
@@ -207,6 +208,23 @@ class Config:
     # its requests requeued. 0 disables (default — on a healthy local
     # backend the watchdog is pure overhead); on the remote tunnel set it
     # WELL above the largest bucket's honest p99 fetch time.
+
+    # cascade serving (ISSUE 16: edge-first inference with confidence-
+    # gated escalation, serving/fleet.py + docs/ARCHITECTURE.md "Cascade
+    # serving")
+    cascade: bool = False         # enroll fleet tenants in the cascade:
+    # requests dispatch to the edge tier first; the in-jit confidence
+    # summary (ops.decode.confidence_summary, riding the box D2H with
+    # zero extra fetches) decides escalation to the quality tier
+    cascade_threshold: Optional[float] = None  # escalate iff confidence
+    # < threshold. None = load the calibrated operating point from the
+    # newest committed artifacts/*/cascade.json (`quality_matrix
+    # --cascade`) via cascade_overrides — the sweep-best promotion idiom;
+    # an explicit value wins (experiments off the calibrated point)
+    cascade_tiers: List[str] = field(
+        default_factory=lambda: ["edge", "quality"])  # (edge, quality)
+    # tier pair the cascade spans; both must be named TIER_PRESETS tiers
+    # with replica slots in the fleet
 
     # augmentation
     crop_percent: List[float] = field(default_factory=lambda: [0.0, 0.1])
@@ -491,6 +509,21 @@ class Config:
         if self.serve_hang_timeout_ms < 0:
             raise ValueError("--serve-hang-timeout-ms must be >= 0, got %r"
                              % (self.serve_hang_timeout_ms,))
+        if self.cascade:
+            if (len(self.cascade_tiers) != 2
+                    or self.cascade_tiers[0] == self.cascade_tiers[1]):
+                raise ValueError(
+                    "--cascade-tiers must name two distinct tiers "
+                    "(edge-hop first), got %r" % (self.cascade_tiers,))
+            bad = [t for t in self.cascade_tiers if t not in TIER_PRESETS]
+            if bad:
+                raise ValueError(
+                    "--cascade-tiers must be named tier presets %s, got %r"
+                    % (sorted(TIER_PRESETS), self.cascade_tiers))
+        if self.cascade_threshold is not None \
+                and not math.isfinite(self.cascade_threshold):
+            raise ValueError("--cascade-threshold must be finite, got %r"
+                             % (self.cascade_threshold,))
         if self.sentinel_spike < 0:
             raise ValueError("--sentinel-spike must be >= 0, got %r"
                              % (self.sentinel_spike,))
@@ -534,6 +567,8 @@ def build_parser() -> argparse.ArgumentParser:
             parser.add_argument(flag, type=elem, nargs="+", default=default)
         elif f.type in ("Optional[int]",):
             parser.add_argument(flag, type=int, default=default)
+        elif f.type in ("Optional[float]",):
+            parser.add_argument(flag, type=float, default=default)
         elif f.type in ("Optional[str]",):
             parser.add_argument(flag, type=str, default=default)
         else:
@@ -599,6 +634,57 @@ def sweep_best_overrides(repo_root: Optional[str] = None) -> dict:
         over["amp"] = True  # the policy's own validity requirement
     over["_source"] = os.path.relpath(path, root)
     return over
+
+
+def cascade_overrides(repo_root: Optional[str] = None) -> dict:
+    """Calibrated cascade operating point from the newest committed
+    `quality_matrix --cascade` artifact (the sweep_best_overrides idiom:
+    the committed artifact IS the promotion record, highest round wins).
+
+    Scans artifacts/*/cascade.json for a `selected` record (threshold +
+    the escalation-rate/blended-mAP evidence it was chosen on) and maps
+    it onto `cascade_threshold`. Raises FileNotFoundError when no
+    artifact carries a selection (a fresh clone, or no calibration round
+    yet) — passing --cascade-threshold explicitly sidesteps the scan."""
+    import glob
+    import re
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    best = None
+    for path in glob.glob(os.path.join(root, "artifacts", "*",
+                                       "cascade.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f).get("selected")
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not rec or "threshold" not in rec:
+            continue
+        m = re.search(r"r(\d+)",
+                      os.path.basename(os.path.dirname(path)))
+        key = int(m.group(1)) if m else -1
+        if best is None or key > best[0]:
+            best = (key, path, rec)
+    if best is None:
+        raise FileNotFoundError(
+            "--cascade: no artifacts/*/cascade.json carries a selected "
+            "operating point — run `quality_matrix --cascade` first, or "
+            "pass --cascade-threshold explicitly")
+    _, path, rec = best
+    return {"cascade_threshold": float(rec["threshold"]),
+            "_source": os.path.relpath(path, root)}
+
+
+def apply_cascade(cfg: Config) -> Config:
+    """Resolve `--cascade` with no explicit threshold into the calibrated
+    operating point (no-op when cascade is off or a threshold was
+    passed)."""
+    if not cfg.cascade or cfg.cascade_threshold is not None:
+        return cfg
+    over = cascade_overrides()
+    src = over.pop("_source")
+    print("--cascade: %s -> %s" % (src, over), flush=True)
+    return dataclasses.replace(cfg, **over)
 
 
 def apply_preset(cfg: Config) -> Config:
@@ -685,6 +771,7 @@ def get_config(argv=None) -> Config:
     cfg = parse_args(argv)
     cfg = apply_tier(cfg)
     cfg = apply_preset(cfg)
+    cfg = apply_cascade(cfg)
     seed_everything(cfg.random_seed)
 
     if cfg.platform:

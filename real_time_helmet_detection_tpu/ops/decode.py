@@ -32,6 +32,59 @@ class Detections(NamedTuple):
     valid: jax.Array   # (topk,) bool — score >= conf_th
 
 
+class CascadeDetections(NamedTuple):
+    """`Detections` plus the scalar cascade-escalation confidence.
+
+    Same leaves as `Detections` with one extra per-image float32 scalar
+    (batched: `(B,)`), so the serving engine's generic per-row fetch split
+    transports it with zero extra D2H — the confidence rides the one
+    box-block `device_get` (docs/ARCHITECTURE.md "Cascade serving").
+    """
+    boxes: jax.Array    # (topk, 4) xyxy at image scale
+    classes: jax.Array  # (topk,) int32
+    scores: jax.Array   # (topk,) float32
+    valid: jax.Array    # (topk,) bool — score >= conf_th
+    confidence: jax.Array  # () float32 — cascade escalation confidence
+
+    def detections(self) -> Detections:
+        """The plain `Detections` view (drops the cascade scalar)."""
+        return Detections(boxes=self.boxes, classes=self.classes,
+                          scores=self.scores, valid=self.valid)
+
+
+# How deep the peak-margin looks: margin = top1 - (MARGIN_K-th best valid
+# score). Fixed (not a flag) so every calibrated threshold artifact refers to
+# the same signal definition.
+MARGIN_K = 8
+
+
+def confidence_summary(scores: jax.Array, valid: jax.Array,
+                       margin_k: int = MARGIN_K) -> jax.Array:
+    """Scalar cascade confidence for one image's masked detections.
+
+    Combines the three signals from the fixed-shape `Detections` block
+    (masks, never boolean filtering):
+
+      top1   = best valid score (0 when the image has no valid detection);
+      margin = top1 minus the `margin_k`-th best valid score — small when
+               many near-tied peaks compete (cluttered / ambiguous scene);
+      frac   = valid-detection count / topk — busy scenes are the ones the
+               edge tier is most likely to get wrong.
+
+    confidence = top1 + margin - frac, a strictly monotone blend in each
+    signal; the absolute scale is irrelevant because the escalation
+    threshold is calibrated against this exact definition
+    (`quality_matrix --cascade`). Escalate when confidence < threshold.
+    """
+    masked = jnp.where(valid, scores, 0.0)
+    k = min(int(margin_k), masked.shape[-1])
+    top = jax.lax.top_k(masked, k)[0]
+    top1 = top[..., 0]
+    margin = top1 - top[..., k - 1]
+    frac = jnp.mean(valid.astype(jnp.float32), axis=-1)
+    return (top1 + margin - frac).astype(jnp.float32)
+
+
 def peak_mask(heatmap: jax.Array, pool_size: int = 3) -> jax.Array:
     """pool_size x pool_size max-pool equality peak test
     (ref transform.py:76-79; the reference parses `--pool-size` but

@@ -23,13 +23,15 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-from .ops.decode import Detections, decode_heatmap, decode_peak_scores
+from .ops.decode import (CascadeDetections, Detections, confidence_summary,
+                         decode_heatmap, decode_peak_scores)
 from .ops.nms import maxpool_nms_mask, nms_mask, soft_nms_mask
 from .ops.pallas import fused_peak_scores
 
 
 def make_predict_fn(model, cfg, normalize: str | None = None,
-                    mesh=None, quant_scales=None) -> Callable:
+                    mesh=None, quant_scales=None,
+                    cascade_summary: bool = False) -> Callable:
     """Build `predict(variables, images) -> Detections` (batched, jitted).
 
     images: (B, H, W, 3) normalized float32 — or, when `normalize` names a
@@ -53,9 +55,16 @@ def make_predict_fn(model, cfg, normalize: str | None = None,
     Decode/NMS always stay float. Eval/export only — training is never
     quantized (docs/ARCHITECTURE.md "Inference compression").
 
-    Returns `Detections` with leading batch dim and N = num_stack * topk
-    entries per image; `valid` combines the conf threshold and the NMS
-    keep mask.
+    `cascade_summary`: when True the program additionally computes the
+    per-image cascade escalation confidence (`ops.decode.confidence_summary`
+    over the final masked detections — masks, not filtering) and returns a
+    `CascadeDetections`; the scalar rides the same output block so it adds
+    ZERO extra D2H. When False (default) the traced program is bit-identical
+    to the pre-cascade predict — the flag only ever ADDS a leaf.
+
+    Returns `Detections` (or `CascadeDetections`) with leading batch dim and
+    N = num_stack * topk entries per image; `valid` combines the conf
+    threshold and the NMS keep mask.
     """
     if normalize is not None:
         from .utils import normalizer_stats
@@ -159,12 +168,28 @@ def make_predict_fn(model, cfg, normalize: str | None = None,
         scores = dets.scores.reshape(b, s * topk)
         valid = dets.valid.reshape(b, s * topk)
         keep, scores = jax.vmap(suppress)(boxes, scores, valid)
+        valid = keep & valid
+        if cascade_summary:
+            conf = jax.vmap(confidence_summary)(scores, valid)
+            return CascadeDetections(boxes=boxes, classes=classes,
+                                     scores=scores, valid=valid,
+                                     confidence=conf)
         return Detections(boxes=boxes, classes=classes, scores=scores,
-                          valid=keep & valid)
+                          valid=valid)
 
     if mesh is None:
         return jax.jit(predict_impl)
     from .parallel import batch_sharding, replicated
+    if cascade_summary:
+        out_sh = CascadeDetections(boxes=batch_sharding(mesh, 3),
+                                   classes=batch_sharding(mesh, 2),
+                                   scores=batch_sharding(mesh, 2),
+                                   valid=batch_sharding(mesh, 2),
+                                   confidence=batch_sharding(mesh, 1))
+        return jax.jit(predict_impl,
+                       in_shardings=(replicated(mesh),
+                                     batch_sharding(mesh, 4)),
+                       out_shardings=out_sh)
     out_sh = Detections(boxes=batch_sharding(mesh, 3),
                         classes=batch_sharding(mesh, 2),
                         scores=batch_sharding(mesh, 2),

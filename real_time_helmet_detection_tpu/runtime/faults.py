@@ -75,11 +75,14 @@ FAULT_KINDS = ("device-loss", "hung-fetch", "slow-batch", "nan-batch",
 # instrumented ones and what seeded schedules draw from by default)
 SERVE_SITES = ("serve:dispatch", "serve:fetch")
 FLEET_SITES = ("fleet:dispatch", "fleet:replica")
+# the cascade escalation hop (ISSUE 16): its own tuple, NOT folded into
+# FLEET_SITES, so existing seeded fleet schedules replay bit-identically
+CASCADE_SITES = ("fleet:escalate",)
 TRAIN_SITES = ("train:batch", "train:rank")
 LOADER_SITES = ("loader:batch", "loader:worker")
 ARTIFACT_SITES = ("artifact:write",)
-ALL_SITES = (SERVE_SITES + FLEET_SITES + TRAIN_SITES + LOADER_SITES
-             + ARTIFACT_SITES)
+ALL_SITES = (SERVE_SITES + FLEET_SITES + CASCADE_SITES + TRAIN_SITES
+             + LOADER_SITES + ARTIFACT_SITES)
 
 # which kinds make sense at which sites (seeded generation honors this;
 # parse() accepts anything — a hand-written schedule may be adversarial)
@@ -92,6 +95,12 @@ SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     # kills the selected replica abruptly and must respawn-and-requeue
     "fleet:dispatch": ("device-loss", "slow-batch"),
     "fleet:replica": ("worker-death",),
+    # the cascade escalation hop (ISSUE 16): device-loss models the quality
+    # tier erroring as the second hop launches, worker-death kills the
+    # SELECTED quality replica out from under the hop — either way the
+    # router must degrade to the in-hand edge answer (`degraded_answer`),
+    # never lose the ack
+    "fleet:escalate": ("device-loss", "worker-death"),
     "train:batch": ("nan-batch", "slow-batch"),
     # a data-parallel training RANK dies (ISSUE 11): the caller raises the
     # UNAVAILABLE signature so the surviving processes' job classifies

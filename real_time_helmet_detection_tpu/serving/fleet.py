@@ -104,11 +104,29 @@ Design rules, each load-bearing:
   replicas' span records. Tracing off threads None everywhere (zero
   device-side difference; pinned by tests/test_trace.py).
 
+* **Cascade serving (ISSUE 16).** Tenants named in `cascade_tenants` take
+  the edge-first path: the request dispatches to the cascade EDGE tier,
+  whose replicas run the confidence-summary predict
+  (`make_predict_fn(cascade_summary=True)` — the per-image scalar rides
+  the box-block D2H, zero extra fetches), and the router escalates to the
+  QUALITY tier iff `confidence < cascade_threshold` (calibrated by
+  `quality_matrix --cascade`). The escalation is a second dispatch of the
+  SAME request through the sanctioned `_dispatch` point, carrying the
+  SAME root TraceContext — `fleet:escalate` marks the hop boundary, both
+  hops' spans land in one trace, and `fleet:e2e` still fires exactly
+  once. A quality tier that cannot answer (dead, shed, deadline, or an
+  injected `fleet:escalate` fault) DEGRADES: the in-hand edge result is
+  returned flagged `degraded_answer` — an acknowledged cascade request is
+  never lost, it just may be answered at edge fidelity
+  (docs/ARCHITECTURE.md "Cascade serving").
+
 Enforcement: graftlint's `ast/engine-bypass-in-fleet` flags raw
 ServingEngine construction or `.engine.submit(...)` calls in fleet/router
 code paths outside the two sanctioned points (`FleetRouter._spawn` and
 `FleetRouter._dispatch`) — fleet traffic goes through router dispatch, or
-the tenant/SLO/canary accounting silently lies.
+the tenant/SLO/canary accounting silently lies. The cascade escalation
+hop is covered by the same rule: it re-enters `_dispatch`, never an
+engine directly.
 """
 
 from __future__ import annotations
@@ -154,10 +172,16 @@ class FleetFuture:
     """Completion handle for one fleet request (the ServeFuture API —
     `result()`/`done()`/`exception()`/`t_submit`/`t_done` — plus the
     dispatch trail: `tenant`, `replicas` (rid per attempt) and
-    `redispatches`). First-wins like ServeFuture."""
+    `redispatches`). First-wins like ServeFuture.
+
+    Cascade flags (ISSUE 16): `escalated` — the edge hop's confidence fell
+    below the threshold and a quality hop was attempted; `degraded_answer`
+    — the quality hop could not answer and the result is the EDGE answer
+    (an acknowledged cascade request degrades, it is never lost)."""
 
     __slots__ = ("_event", "_value", "_error", "t_submit", "t_done",
-                 "deadline", "tenant", "replicas", "redispatches", "ctx")
+                 "deadline", "tenant", "replicas", "redispatches", "ctx",
+                 "escalated", "degraded_answer")
 
     def __init__(self, tenant: str, deadline: Optional[float] = None):
         self._event = threading.Event()
@@ -170,6 +194,8 @@ class FleetFuture:
         self.replicas: List[int] = []
         self.redispatches = 0
         self.ctx = None  # root TraceContext when tracing is on (ISSUE 14)
+        self.escalated = False        # cascade: quality hop attempted
+        self.degraded_answer = False  # cascade: answered at edge fidelity
 
     def _set(self, value) -> bool:
         if self._event.is_set():
@@ -232,16 +258,22 @@ class _Tenant:
 
 
 class _Request:
-    __slots__ = ("image", "future", "attempts", "tier", "ctx")
+    __slots__ = ("image", "future", "attempts", "tier", "ctx",
+                 "cascade", "edge_result", "edge_rid")
 
     def __init__(self, image: np.ndarray, future: FleetFuture,
-                 tier: Optional[str] = None, ctx=None):
+                 tier: Optional[str] = None, ctx=None,
+                 cascade: bool = False):
         self.image = image
         self.future = future
         self.attempts = 0  # re-dispatches consumed
         self.tier = tier   # tier pin (ISSUE 13): None = any replica
         self.ctx = ctx     # root TraceContext (ISSUE 14): the router
         # mints it and owns the closure; replicas only add child hops
+        self.cascade = cascade  # edge-first routing (ISSUE 16)
+        self.edge_result = None  # first-hop answer, held across the
+        # escalation — the degraded-answer fallback if quality can't serve
+        self.edge_rid = -1
 
 
 class FleetRouter:
@@ -269,9 +301,20 @@ class FleetRouter:
     metrics : fleet obs.metrics registry (default: the process-wide one,
         engine.py's convention).
     watchdog_objective/burn : per-tenant + canary burn-rule tuning.
-    injector : runtime.faults.ChaosInjector for the `fleet:*` sites.
+    injector : runtime.faults.ChaosInjector for the `fleet:*` sites
+        (incl. the `fleet:escalate` cascade site).
     tracer : obs.spans tracer (default: $OBS_SPAN_LOG via maybe_tracer).
     start : construct paused replicas (tests) — `start()` arms them.
+    cascade_tenants : tenants routed edge-first with confidence-gated
+        escalation (ISSUE 16; module docstring). Empty/None = cascade off.
+    cascade_tiers : (edge_tier, quality_tier) pair the cascade spans;
+        both must have replica slots. The edge tier's replicas must run
+        the confidence-summary predict (`cascade_summary=True`) — a
+        result without a `confidence` leaf escalates unconditionally
+        (correctness over throughput) and is worth a graftlint look.
+    cascade_threshold : escalate iff confidence < threshold (the
+        calibrated operating point from `quality_matrix --cascade`;
+        config loads it via `cascade_overrides`).
     """
 
     def __init__(self, replica_factory: Callable[[int, bool],
@@ -286,7 +329,10 @@ class FleetRouter:
                  tenant_shed_requests: Optional[int] = None,
                  metrics=None, watchdog_objective: float = 0.05,
                  watchdog_burn: float = 2.0, injector=None, tracer=None,
-                 start: bool = True):
+                 start: bool = True,
+                 cascade_tenants: Optional[Sequence[str]] = None,
+                 cascade_tiers: Sequence[str] = ("edge", "quality"),
+                 cascade_threshold: float = 0.0):
         from ..obs import metrics as metrics_mod
         from ..obs.slo import SloWatchdog, default_tenant_rules
         from ..obs.spans import maybe_tracer
@@ -311,6 +357,23 @@ class FleetRouter:
                 "tenant_tiers name tier(s) with no replica slot: %s "
                 "(replica tiers: %s)"
                 % (sorted(unknown), sorted(set(self._tiers))))
+        # cascade policy (ISSUE 16): enabled iff any tenant is enrolled
+        self._cascade_tenants = frozenset(
+            _sanitize_tenant(t) for t in (cascade_tenants or ()))
+        ctiers = tuple(str(t) for t in cascade_tiers)
+        self._cascade_tiers = ctiers
+        self._cascade_threshold = float(cascade_threshold)
+        if self._cascade_tenants:
+            if len(ctiers) != 2 or ctiers[0] == ctiers[1]:
+                raise ValueError(
+                    "cascade_tiers must be a (edge, quality) pair of two "
+                    "distinct tiers, got %r" % (ctiers,))
+            missing = set(ctiers) - set(self._tiers)
+            if missing:
+                raise ValueError(
+                    "cascade tier(s) with no replica slot: %s (replica "
+                    "tiers: %s)" % (sorted(missing),
+                                    sorted(set(self._tiers))))
         # stable weights are PER TIER (a quality checkpoint cannot fit an
         # edge replica's param tree); a plain pytree `variables` applies
         # to every tier — the homogeneous-fleet (pre-tier) behavior
@@ -338,7 +401,8 @@ class FleetRouter:
             "submitted", "completed", "lost", "shed_tenant",
             "shed_capacity", "shed_deadline", "redispatched",
             "dispatch_faults", "replica_deaths", "respawns", "rollouts",
-            "promotes", "rollbacks")}
+            "promotes", "rollbacks", "escalated", "edge_resolved",
+            "degraded_answers")}
         self._mg_replicas = mm.gauge("fleet.replicas")
         self._mh_e2e = mm.histogram("fleet.e2e_ms")
 
@@ -427,6 +491,10 @@ class FleetRouter:
                          for rep in reps],
             "tenants": tenants,
             "tenant_tiers": dict(self._tenant_tiers),
+            "cascade": (None if not self._cascade_tenants else {
+                "tiers": list(self._cascade_tiers),
+                "threshold": self._cascade_threshold,
+                "tenants": sorted(self._cascade_tenants)}),
             "canary": (None if canary is None
                        else {"rid": canary.rid,
                              "frac": canary_frac}),
@@ -585,6 +653,11 @@ class FleetRouter:
 
     def _shed(self, req: _Request, reason: str,
               error: SheddedError) -> None:
+        if req.edge_result is not None:
+            # cascade (ISSUE 16): the quality hop shed, but the edge
+            # answer is in hand — degrade instead of losing the ack
+            self._degrade(req, "shed-" + reason)
+            return
         fut = req.future
         if not fut._fail(error):
             return
@@ -598,40 +671,118 @@ class FleetRouter:
         self._tracer.event("fleet:shed", ctx=req.ctx, reason=reason,
                            tenant=fut.tenant)
 
+    def _complete(self, req: _Request, rid: int, value,
+                  degraded: bool = False) -> None:
+        """Resolve + account one fleet request (the ONE completion path:
+        plain, cascade edge-resolve, escalated, and degraded answers all
+        end here, so `fleet:e2e` fires exactly once per trace)."""
+        fut = req.future
+        if degraded:
+            fut.degraded_answer = True
+        if not fut._set(value):
+            return
+        e2e_ms = (fut.t_done - fut.t_submit) * 1e3
+        with self._lock:
+            t = self._tenant(fut.tenant)
+            t.outstanding = max(0, t.outstanding - 1)
+            t.c_completed.inc()
+            t.h_e2e.observe(e2e_ms)
+            fired = self._watchdog.check()
+            self._tenant_alerts(fired)
+        self._mc["completed"].inc()
+        if degraded:
+            self._mc["degraded_answers"].inc()
+        self._mh_e2e.observe(e2e_ms)
+        # the fleet-level e2e closes the trace the router minted
+        # (the replica's serve:e2e is a child hop of it); cascade
+        # requests carry their outcome so waterfalls and obs_report
+        # attribute two-hop tails without re-deriving the policy
+        extra = ({"escalated": fut.escalated,
+                  "degraded": fut.degraded_answer}
+                 if req.cascade else {})
+        self._tracer.record("fleet:e2e", fut.t_done - fut.t_submit,
+                            ctx=req.ctx, tenant=fut.tenant, rid=rid,
+                            redispatches=fut.redispatches, **extra)
+        self._m_writer.maybe_flush()
+
+    def _degrade(self, req: _Request, reason: str) -> None:
+        """Cascade fallback (ISSUE 16): the quality hop cannot answer
+        (dead tier, shed, deadline, injected fault) — resolve with the
+        in-hand EDGE result, flagged `degraded_answer`. Never a lost
+        ack; never re-raised."""
+        self._tracer.event("fleet:degraded",
+                           ctx=(req.ctx.child() if req.ctx else None),
+                           tenant=req.future.tenant,
+                           reason=str(reason)[:200])
+        self._complete(req, req.edge_rid, req.edge_result, degraded=True)
+
+    def _escalate(self, req: _Request, rid: int, value,
+                  confidence) -> None:
+        """Edge confidence below threshold: hold the edge answer and
+        dispatch the SAME request (same future, same root TraceContext)
+        to the quality tier as a child hop."""
+        fut = req.future
+        req.edge_result = value
+        req.edge_rid = rid
+        req.tier = self._cascade_tiers[1]
+        fut.escalated = True
+        self._mc["escalated"].inc()
+        self._tracer.event("fleet:escalate",
+                           ctx=(req.ctx.child() if req.ctx else None),
+                           rid=rid, tenant=fut.tenant,
+                           confidence=(None if confidence is None
+                                       else float(confidence)),
+                           threshold=self._cascade_threshold)
+        if self._injector is not None:
+            # the fleet:escalate chaos site (runtime/faults.py): a
+            # device-loss here models the quality tier erroring as the
+            # hop launches -> degrade; a worker-death kills the SELECTED
+            # quality replica (a different engine than the one whose
+            # fetcher thread runs this callback — killing our own would
+            # self-join) and the hop proceeds through the respawn
+            try:
+                ev = self._injector.fire("fleet:escalate")
+            except Exception as e:  # noqa: BLE001 — injected hop fault
+                self._degrade(req, "escalate-fault:" + type(e).__name__)
+                return
+            if ev is not None and ev.kind == "worker-death":
+                self._kill_least_loaded(tier=req.tier)
+        if not self._dispatch(req, exclude_engines=set()):
+            self._degrade(req, "no-quality-capacity")
+
     def _on_replica_done(self, req: _Request, rid: int, engine,
                          sf) -> None:
-        """Replica future completed: success -> complete + account;
-        deadline shed -> propagate; replica failure -> bounded
-        re-dispatch elsewhere, else the error surfaces (a lost ack).
-        `engine` is the engine the request FAILED ON (pinned at dispatch
-        — after a respawn the slot holds a fresh engine that must remain
-        a re-dispatch candidate, single-replica fleets included)."""
+        """Replica future completed: success -> complete + account (or,
+        for a cascade first hop below threshold, escalate); deadline
+        shed -> propagate; replica failure -> bounded re-dispatch
+        elsewhere, else the error surfaces (a lost ack) — unless an edge
+        answer is in hand, which degrades instead. `engine` is the
+        engine the request FAILED ON (pinned at dispatch — after a
+        respawn the slot holds a fresh engine that must remain a
+        re-dispatch candidate, single-replica fleets included)."""
         fut = req.future
         err = sf.exception()
         if err is None:
-            if fut._set(sf._value):
-                e2e_ms = (fut.t_done - fut.t_submit) * 1e3
-                with self._lock:
-                    t = self._tenant(fut.tenant)
-                    t.outstanding = max(0, t.outstanding - 1)
-                    t.c_completed.inc()
-                    t.h_e2e.observe(e2e_ms)
-                    fired = self._watchdog.check()
-                    self._tenant_alerts(fired)
-                self._mc["completed"].inc()
-                self._mh_e2e.observe(e2e_ms)
-                # the fleet-level e2e closes the trace the router minted
-                # (the replica's serve:e2e is a child hop of it)
-                self._tracer.record("fleet:e2e",
-                                    fut.t_done - fut.t_submit,
-                                    ctx=req.ctx, tenant=fut.tenant,
-                                    rid=rid,
-                                    redispatches=fut.redispatches)
-                self._m_writer.maybe_flush()
+            value = sf._value
+            if req.cascade and req.edge_result is None:
+                # cascade first hop: the in-jit confidence decides.
+                # A missing confidence leaf (edge replicas built without
+                # cascade_summary) escalates unconditionally —
+                # correctness over throughput
+                conf = getattr(value, "confidence", None)
+                if conf is not None \
+                        and float(conf) >= self._cascade_threshold:
+                    self._mc["edge_resolved"].inc()
+                    self._complete(req, rid, value)
+                else:
+                    self._escalate(req, rid, value, conf)
+                return
+            self._complete(req, rid, value)
             return
         if isinstance(err, SheddedError):
             # the engine shed on DEADLINE (fleet admission already
             # happened): propagate — expired work is not re-dispatched
+            # (a cascade second hop degrades inside _shed)
             self._shed(req, "deadline", err)
             return
         # replica-level failure: re-dispatch within budget and deadline
@@ -649,6 +800,11 @@ class FleetRouter:
             if self._dispatch(req, exclude_engines={id(engine)}):
                 return
             # nobody could take it: fall through to surface the error
+        if req.edge_result is not None:
+            # cascade: the quality hop failed out of budget — the edge
+            # answer still stands (degraded, never lost)
+            self._degrade(req, "hop-failure:" + type(err).__name__)
+            return
         if fut._fail(err):
             with self._lock:
                 t = self._tenant(fut.tenant)
@@ -681,15 +837,23 @@ class FleetRouter:
         `tier` (ISSUE 13) pins the request to that tier's replicas;
         unset, the tenant's `tenant_tiers` policy applies (bulk tenants
         -> cheap tier, flagged -> quality — the ROADMAP interplay); a
-        tenant with no policy routes fleet-wide as before."""
+        tenant with no policy routes fleet-wide as before. A
+        `cascade_tenants` tenant with no explicit pin takes the
+        edge-first cascade path instead (ISSUE 16) — an explicit `tier=`
+        opts a single request out of the cascade."""
         del block  # API-compat only: a router shed is always immediate
         with self._lock:
             closing = self._closing
         if closing:
             raise EngineClosedError("fleet router closed")
         tenant = _sanitize_tenant(tenant)
+        cascade = False
         if tier is None:
-            tier = self._tenant_tiers.get(tenant)
+            if tenant in self._cascade_tenants:
+                cascade = True
+                tier = self._cascade_tiers[0]  # edge hop first
+            else:
+                tier = self._tenant_tiers.get(tenant)
         elif tier not in set(self._tiers):
             raise ValueError("unknown tier %r (replica tiers: %s)"
                              % (tier, sorted(set(self._tiers))))
@@ -700,7 +864,8 @@ class FleetRouter:
         # scoring, the canary split, every replica hop and re-dispatch
         ctx = new_root() if self._tracer.enabled else None
         fut.ctx = ctx
-        req = _Request(np.asarray(image), fut, tier=tier, ctx=ctx)
+        req = _Request(np.asarray(image), fut, tier=tier, ctx=ctx,
+                       cascade=cascade)
         self._mc["submitted"].inc()
         # fleet:replica chaos: a worker-death kills the replica the
         # request WOULD have routed to (submit path only — never from an
@@ -755,9 +920,11 @@ class FleetRouter:
 
     # ---- replica death / respawn -----------------------------------------
 
-    def _kill_least_loaded(self) -> None:
+    def _kill_least_loaded(self, tier: Optional[str] = None) -> None:
         with self._lock:
             reps = list(self._replicas)
+        if tier is not None:
+            reps = [rep for rep in reps if rep.tier == tier]
         best = None
         for rep in reps:
             ss = self._score(rep)

@@ -779,6 +779,17 @@ def _selfcheck_trace(check) -> None:
                         (variables_e, images_e), "predict_epilogue_fused")
     check("fused-epilogue predict audits clean", not ef)
 
+    # the cascade-summary predict (ISSUE 16): the edge serving program
+    # with the in-jit confidence summary — the FleetRouter escalation
+    # signal rides this trace, so dynamic shapes/f64/retrace instability
+    # here would recompile on the cascade hot path (baseline stays EMPTY)
+    predict_c, variables_c, images_c = ta._tiny_predict_parts(
+        arch=dict(ta.TIER_AUDIT[0][1]), cascade_summary=True)
+    cf = ta.audit_entry(lambda v, im: predict_c(v, im),
+                        (variables_c, images_c),
+                        "predict_cascade_summary[tier=edge]")
+    check("cascade-summary predict audits clean", not cf)
+
 
 def selfcheck(ast_only: bool = False) -> int:
     t0 = time.time()

@@ -27,9 +27,11 @@ ONE artifact:
   joined into traces; per-request questions start HERE).
 
 Output: `artifacts/<round>/obs/report.md` (human) + `report.json` and ONE
-JSON line on stdout (machine), schema `obs-report-v5` (v1–v4 reports —
+JSON line on stdout (machine), schema `obs-report-v6` (v1–v5 reports —
 earlier rounds — stay readable via `read_report`, which nulls the
-sections each lacks). Everything is read-only over its inputs (the queue
+sections each lacks, incl. the v6 Fleet **Cascade** subsection:
+escalation rate, per-hop e2e split and degraded-answer accounting joined
+from `fleet:escalate`/`fleet:degraded`/`fleet:e2e` spans). Everything is read-only over its inputs (the queue
 journal is parsed tolerantly, torn tails dropped, never repaired in
 place) and CPU-only — run it after any round, chip or not.
 
@@ -62,12 +64,13 @@ from real_time_helmet_detection_tpu.obs.spans import (  # noqa: E402
 from real_time_helmet_detection_tpu.utils import (  # noqa: E402
     atomic_write_bytes, save_json)
 
-SCHEMA = "obs-report-v5"
+SCHEMA = "obs-report-v6"
 READABLE_SCHEMAS = ("obs-report-v1", "obs-report-v2", "obs-report-v3",
-                    "obs-report-v4", "obs-report-v5")
+                    "obs-report-v4", "obs-report-v5", "obs-report-v6")
 # sections older schemas lack; read_report nulls them (v1 lacks every
 # group, v2 lacks Scaling + Fleet + Traces, v3 lacks Fleet + Traces,
-# v4 lacks Traces)
+# v4 lacks Traces; v5 fleet sections lack the Cascade subsection,
+# nulled inside the fleet dict)
 V2_SECTIONS = ("metrics", "slo")
 V3_SECTIONS = ("scaling",)
 V4_SECTIONS = ("fleet",)
@@ -90,6 +93,8 @@ def read_report(path: str) -> Optional[Dict]:
         return None
     for section in V2_SECTIONS + V3_SECTIONS + V4_SECTIONS + V5_SECTIONS:
         rep.setdefault(section, None)
+    if isinstance(rep.get("fleet"), dict):
+        rep["fleet"].setdefault("cascade", None)  # pre-v6 fleet sections
     return rep
 
 
@@ -385,6 +390,15 @@ def summarize_fleet(paths: List[str]) -> Optional[Dict]:
     rollouts: Dict[str, int] = {}
     redispatches = lost = 0
     timeline: List[Dict] = []
+    # Cascade subsection (ISSUE 16, obs-report-v6): escalation events +
+    # their confidence distribution, degraded-answer reasons, and the
+    # per-outcome e2e split read off fleet:e2e's escalated/degraded meta
+    # (the cascade markers ride the records the router already writes)
+    casc_events = 0
+    casc_conf: List[float] = []
+    casc_degraded: Dict[str, int] = {}
+    casc_e2e = {"requests": 0, "escalated": 0, "degraded": 0}
+    casc_ms: Dict[str, List[float]] = {"edge": [], "escalated": []}
     for path in paths:
         for rec in read_spans(path):
             name = rec.get("name", "")
@@ -397,6 +411,29 @@ def summarize_fleet(paths: List[str]) -> Optional[Dict]:
                     by_replica[rid] = by_replica.get(rid, 0) + 1
                     continue  # per-dispatch records stay out of the
                     # timeline (volume)
+                if what == "escalate":
+                    casc_events += 1
+                    c = meta.get("confidence")
+                    if isinstance(c, (int, float)):
+                        casc_conf.append(float(c))
+                    continue  # per-escalation volume, like dispatch
+                if what == "e2e" and "escalated" in meta:
+                    casc_e2e["requests"] += 1
+                    dur = rec.get("dur_s")
+                    hop = "escalated" if meta.get("escalated") else "edge"
+                    if meta.get("escalated"):
+                        casc_e2e["escalated"] += 1
+                    if meta.get("degraded"):
+                        casc_e2e["degraded"] += 1
+                    if isinstance(dur, (int, float)):
+                        casc_ms[hop].append(dur * 1e3)
+                    continue  # per-request volume
+                if what == "degraded":
+                    reason = meta.get("reason", "?")
+                    casc_degraded[reason] = casc_degraded.get(reason,
+                                                              0) + 1
+                    # stays in the timeline: rare, and the join point
+                    # against alert:*/fault:* for why the tier was out
                 if what == "redispatch":
                     redispatches += 1
                 elif what == "lost":
@@ -421,14 +458,36 @@ def summarize_fleet(paths: List[str]) -> Optional[Dict]:
             elif name.startswith(("alert:", "fault:")):
                 timeline.append({"t": t, "what": name.split(":", 1)[0],
                                  "name": name})
-    if not (by_replica or lifecycle or rollouts or shed or redispatches):
+    if not (by_replica or lifecycle or rollouts or shed or redispatches
+            or casc_e2e["requests"] or casc_events):
         return None
     timeline.sort(key=lambda r: (r.get("t") is None, r.get("t")))
+    cascade = None
+    if casc_e2e["requests"] or casc_events:
+        n = casc_e2e["requests"]
+        cascade = {
+            "requests": n,
+            "escalated": casc_e2e["escalated"],
+            "escalation_rate": (round(casc_e2e["escalated"] / n, 4)
+                                if n else None),
+            "degraded_answers": casc_e2e["degraded"],
+            "degraded_reasons": dict(sorted(casc_degraded.items())),
+            "escalate_events": casc_events,
+            "confidence": ({"min": round(min(casc_conf), 4),
+                            "max": round(max(casc_conf), 4)}
+                           if casc_conf else None),
+            "e2e_ms_by_hop": {
+                hop: ({"n": len(v),
+                       "p50": round(_pctl(sorted(v), 0.50), 3),
+                       "p99": round(_pctl(sorted(v), 0.99), 3)}
+                      if v else None)
+                for hop, v in casc_ms.items()}}
     return {"dispatches_by_replica": dict(sorted(by_replica.items())),
             "dispatches_total": sum(by_replica.values()),
             "redispatches": redispatches, "lost": lost, "shed": shed,
             "tenants_shed": tenants_shed, "lifecycle": lifecycle,
-            "rollouts": rollouts, "timeline": timeline}
+            "rollouts": rollouts, "cascade": cascade,
+            "timeline": timeline}
 
 
 def summarize_traces(paths: List[str], top_n: int = 5) -> Optional[Dict]:
@@ -775,6 +834,32 @@ def render_markdown(rep: Dict) -> str:
             lines += ["Canary: " + ", ".join(
                 "%s ×%d" % (k, v)
                 for k, v in sorted(ft["rollouts"].items())), ""]
+        cs = ft.get("cascade")
+        if cs:
+            lines += ["### Cascade", ""]
+            rate = cs.get("escalation_rate")
+            lines += ["%d cascade request(s): %d escalated (%s), "
+                      "%d degraded answer(s)%s"
+                      % (cs["requests"], cs["escalated"],
+                         ("rate %.1f%%" % (100 * rate)
+                          if isinstance(rate, (int, float)) else "rate ?"),
+                         cs["degraded_answers"],
+                         ("; reasons: " + ", ".join(
+                             "%s ×%d" % (k, v) for k, v in
+                             cs["degraded_reasons"].items())
+                          if cs["degraded_reasons"] else "")), ""]
+            hops = cs.get("e2e_ms_by_hop") or {}
+            hop_bits = ["%s p50 %s ms p99 %s ms (n=%d)"
+                        % (hop, h["p50"], h["p99"], h["n"])
+                        for hop, h in hops.items() if h]
+            if hop_bits:
+                lines += ["Per-hop e2e: " + "; ".join(hop_bits), ""]
+            if cs.get("confidence"):
+                lines += ["Escalation confidence range [%s, %s] over %d "
+                          "fleet:escalate event(s)"
+                          % (cs["confidence"]["min"],
+                             cs["confidence"]["max"],
+                             cs["escalate_events"]), ""]
         if ft["timeline"]:
             lines += ["| t | what | event |", "|---|---|---|"]
             for ev in ft["timeline"]:
@@ -1025,6 +1110,23 @@ def selfcheck() -> int:
                                                  "dangling-child",
                                                  "never-written"))
         tracer.record("serve:e2e", 0.005, ctx=broken)
+        # cascade taxonomy (ISSUE 16, obs-report-v6): an edge-resolved
+        # request, an escalated two-hop request and a degraded answer —
+        # the Fleet Cascade subsection's joins (ctx-free on purpose: the
+        # cascade counters read the e2e meta, not the trace graph, so
+        # the Traces-section fixtures above stay untouched)
+        tracer.record("fleet:e2e", 0.006, rid=0, escalated=False,
+                      degraded=False)
+        tracer.event("fleet:escalate", rid=0, tenant="cas",
+                     confidence=0.12, threshold=0.3)
+        tracer.record("fleet:e2e", 0.030, rid=1, escalated=True,
+                      degraded=False)
+        tracer.event("fleet:escalate", rid=0, tenant="cas",
+                     confidence=0.05, threshold=0.3)
+        tracer.event("fleet:degraded", tenant="cas",
+                     reason="escalate-fault:InjectedBackendError")
+        tracer.record("fleet:e2e", 0.009, rid=0, escalated=True,
+                      degraded=True)
         tracer.close()
         with open(span_path, "a") as f:  # graftlint: off=raw-artifact-write
             f.write('{"kind": "span", "torn')  # kill -9 mid-append twin
@@ -1133,11 +1235,11 @@ def selfcheck() -> int:
         check("schema tagged", rep["schema"] == SCHEMA)
         sp = rep["spans"]
         check("torn span tail dropped, all real records read",
-              sp["records"] == 61)  # meta + 4 steps + ckpt + hb + ctx
+              sp["records"] == 67)  # meta + 4 steps + ckpt + hb + ctx
         # + 16 serve spans + shed event + 7 fault/recover events +
         # reload span + 2 alert events + 4 scale spans + 10 fleet events
-        # + 10 trace-fixture records + log2's meta + rank-1 step (both
-        # torn tails dropped)
+        # + 10 trace-fixture records + 6 cascade records + log2's meta +
+        # rank-1 step (both torn tails dropped)
         check("step span stats", sp["by_name"].get("step", {}).get(
             "count") == 5 and abs(sp["by_name"]["step"]["total_s"]
                                   - 0.11) < 1e-6)
@@ -1223,6 +1325,23 @@ def selfcheck() -> int:
               and (ft_names.index("fleet:rollout rid=1")
                    < ft_names.index(
                        "fleet:rollback rid=1 (canary-error-burn)")))
+        cs = ft["cascade"]
+        check("fleet cascade subsection joined",
+              cs is not None and cs["requests"] == 3
+              and cs["escalated"] == 2
+              and cs["escalation_rate"] == round(2 / 3, 4)
+              and cs["degraded_answers"] == 1
+              and cs["escalate_events"] == 2
+              and cs["degraded_reasons"]
+              == {"escalate-fault:InjectedBackendError": 1}
+              and cs["confidence"] == {"min": 0.05, "max": 0.12})
+        check("cascade per-hop e2e split",
+              (cs["e2e_ms_by_hop"]["edge"] or {}).get("n") == 1
+              and cs["e2e_ms_by_hop"]["edge"]["p50"] == 6.0
+              and (cs["e2e_ms_by_hop"]["escalated"] or {}).get("n") == 2)
+        check("cascade volume stays out of the fleet timeline",
+              not any(n.startswith("fleet:escalate") for n in ft_names)
+              and any(n.startswith("fleet:degraded") for n in ft_names))
         trc = rep["traces"]
         check("traces section joined", trc is not None
               and trc["request_traces"] == 4 and trc["closed"] == 3
@@ -1281,6 +1400,10 @@ def selfcheck() -> int:
               "## Traces" in md and "HARD ERRORS" in md
               and "dominant stage serve:compute" in md
               and "fleet:redispatch ×1" in md)
+        check("markdown carries cascade subsection",
+              "### Cascade" in md and "2 escalated (rate 66.7%)" in md
+              and "1 degraded answer(s)" in md
+              and "escalate-fault:InjectedBackendError" in md)
 
         # schema compat: the generated v2 report reads back through
         # read_report, and a committed v1 report (a pre-ISSUE-10 round)
@@ -1340,6 +1463,24 @@ def selfcheck() -> int:
               v4 is not None and v4["traces"] is None
               and v4["fleet"] is not None
               and v4["spans"]["records"] == 9)
+        # a committed v5 report (pre-ISSUE-16 round) keeps its fleet
+        # section but nulls the Cascade subsection inside it
+        v5_path = os.path.join(tmp, "report_v5.json")
+        atomic_write_bytes(v5_path, json.dumps(
+            {"schema": "obs-report-v5", "round": "r15",
+             "metrics": {"files": []}, "slo": None,
+             "scaling": {"files": [], "spans": {}},
+             "fleet": {"dispatches_total": 3},
+             "traces": {"traces": 0},
+             "spans": {"records": 11}}).encode())
+        v5 = read_report(v5_path)
+        check("v5 report readable with fleet cascade nulled",
+              v5 is not None and v5["fleet"] is not None
+              and v5["fleet"]["cascade"] is None
+              and v5["traces"] is not None
+              and v5["spans"]["records"] == 11)
+        check("v1-v4 fleet sections also null cascade on read",
+              v4["fleet"]["cascade"] is None)
         junk_path = os.path.join(tmp, "report_junk.json")
         atomic_write_bytes(junk_path, json.dumps(
             {"schema": "obs-report-v9"}).encode())

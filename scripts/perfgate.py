@@ -289,6 +289,66 @@ def obs_from_fleet_artifact(d: Dict, rnd: int, source: str) -> List[Obs]:
     return out
 
 
+def obs_from_cascade_bench(d: Dict, rnd: int, source: str) -> List[Obs]:
+    """serve-bench-cascade-v1 rows (ISSUE 16): the cascade-vs-all-quality
+    goodput ratio gates in the tight `eff` class — both sides run on the
+    same box at the same time over the same seeded arrival trace, so box
+    noise cancels exactly like fleet scaling_eff; the per-mode goodput
+    and p99 rows ride in the wide rate/time classes."""
+    if d.get("schema") != "serve-bench-cascade-v1":
+        return []
+    platform = d.get("platform") or "?"
+    sig = "%s,%s,simq%g,sime%g,x%g" % (
+        platform, d.get("imsize", "?"), d.get("quality_sim_ms", 0),
+        d.get("edge_sim_ms", 0), d.get("cascade_load", 0))
+    out = []
+    if isinstance(d.get("cascade_goodput_ratio"), (int, float)):
+        out.append(Obs("cascade[%s].goodput_ratio" % sig,
+                       d["cascade_goodput_ratio"], HIGHER, "eff",
+                       platform, rnd, source))
+    for row in d.get("rows") or []:
+        mode = row.get("mode")
+        if not mode:
+            continue
+        if isinstance(row.get("goodput_rps"), (int, float)):
+            out.append(Obs("cascade[%s].goodput@%s" % (sig, mode),
+                           row["goodput_rps"], HIGHER, "rate", platform,
+                           rnd, source))
+        if isinstance(row.get("p99_ms"), (int, float)):
+            out.append(Obs("cascade[%s].p99_ms@%s" % (sig, mode),
+                           row["p99_ms"], LOWER, "time", platform, rnd,
+                           source))
+    return out
+
+
+def obs_from_cascade_calibration(d: Dict, rnd: int, source: str) -> \
+        List[Obs]:
+    """cascade-calibration-v1 (ISSUE 16): the selected operating point's
+    blended fixture mAP and its delta vs all-quality routing gate in the
+    ABSOLUTE `quality` class (a blended answer drifting >2 pts below
+    all-quality fails on any platform), alongside the two endpoint
+    anchors. Keyed on the fixture scale so a smoke calibration never
+    gates a chip-scale one."""
+    if d.get("schema") != "cascade-calibration-v1":
+        return []
+    platform = d.get("platform") or "?"
+    fix = d.get("fixture") or {}
+    sig = "%s,%s,%s%s" % (platform, fix.get("imsize", "?"),
+                          fix.get("style", "?"),
+                          ",smoke" if d.get("smoke") else "")
+    out = []
+    sel = d.get("selected") or {}
+    for key, val in (("blended_map", sel.get("blended_mAP")),
+                     ("delta_vs_all_quality",
+                      sel.get("delta_vs_all_quality")),
+                     ("all_quality_map", d.get("all_quality_mAP")),
+                     ("all_edge_map", d.get("all_edge_mAP"))):
+        if isinstance(val, (int, float)):
+            out.append(Obs("cascadecal[%s].%s" % (sig, key), val, HIGHER,
+                           "quality", platform, rnd, source))
+    return out
+
+
 def obs_from_roofline(d: Dict, rnd: int, source: str) -> List[Obs]:
     if d.get("schema") != "roofline-v1":
         return []  # roofline-diff-v1 etc. are derived artifacts
@@ -452,6 +512,7 @@ def scan_observations(root: str) -> List[Obs]:
             continue
         out += obs_from_serve_artifact(d, _round_of(path), rel(path))
         out += obs_from_fleet_artifact(d, _round_of(path), rel(path))
+        out += obs_from_cascade_bench(d, _round_of(path), rel(path))
     for path in sorted(glob.glob(os.path.join(
             root, "artifacts", "*", "roofline", "*.json"))):
         try:
@@ -476,6 +537,14 @@ def scan_observations(root: str) -> List[Obs]:
         except (OSError, json.JSONDecodeError):
             continue
         out += obs_from_quality_matrix(d, _round_of(path), rel(path))
+    for path in sorted(glob.glob(os.path.join(
+            root, "artifacts", "*", "cascade.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        out += obs_from_cascade_calibration(d, _round_of(path), rel(path))
     for path in sorted(glob.glob(os.path.join(
             root, "artifacts", "*", "obs", "metrics*.jsonl"))):
         out += obs_from_metrics_jsonl(path, _round_of(path), rel(path))
@@ -613,6 +682,10 @@ def candidate_observations(path: str) -> List[Obs]:
         return obs_from_serve_artifact(d, rnd, path)
     if d.get("schema") == "serve-bench-fleet-v1":
         return obs_from_fleet_artifact(d, rnd, path)
+    if d.get("schema") == "serve-bench-cascade-v1":
+        return obs_from_cascade_bench(d, rnd, path)
+    if d.get("schema") == "cascade-calibration-v1":
+        return obs_from_cascade_calibration(d, rnd, path)
     if d.get("schema") == "roofline-v1":
         return obs_from_roofline(d, rnd, path)
     if d.get("schema") == "scaling-v2":
@@ -766,6 +839,14 @@ def _fixture_tree(tmp: str) -> None:
     # seeded -3 pt candidate must FAIL against (absolute quality class)
     jline(os.path.join(tmp, "artifacts", "r02", "quality_matrix.json"),
           _quality_fixture(0.71))
+    # serve-bench-cascade-v1 + cascade-calibration-v1 (ISSUE 16): the
+    # cascade acceptance fixtures — a -20% goodput-ratio regression and
+    # a -3 pt blended-mAP drift must both FAIL
+    jline(os.path.join(tmp, "artifacts", "r02", "serving",
+                       "serve_bench_cascade.json"),
+          _cascade_bench_fixture(2.6, 1900.0))
+    jline(os.path.join(tmp, "artifacts", "r02", "cascade.json"),
+          _cascade_calib_fixture(0.78))
 
 
 def _quality_fixture(edge_map: float) -> Dict:
@@ -784,6 +865,34 @@ def _quality_fixture(edge_map: float) -> Dict:
                             "mAP": 0.80, "distilled": False,
                             "serve_wire_ms_b1": 55.0,
                             "predict_bytes": 4.0e8}}}
+
+
+def _cascade_bench_fixture(ratio: float, casc_goodput: float) -> Dict:
+    return {"schema": "serve-bench-cascade-v1", "platform": "cpu",
+            "imsize": 64, "quality_sim_ms": 40.0, "edge_sim_ms": 5.0,
+            "cascade_load": 5.0, "cascade_threshold": 0.1,
+            "cascade_goodput_ratio": ratio,
+            "escalation_rate": 0.03,
+            "rows": [
+                {"mode": "cascade", "goodput_rps": casc_goodput,
+                 "p99_ms": 90.0, "lost": 0},
+                {"mode": "all-quality",
+                 "goodput_rps": round(casc_goodput / ratio, 2),
+                 "p99_ms": 250.0, "lost": 0}],
+            "gate_cascade_2x": True, "gate_zero_lost_acks": True}
+
+
+def _cascade_calib_fixture(blended_map: float) -> Dict:
+    return {"schema": "cascade-calibration-v1", "platform": "cpu",
+            "smoke": True,
+            "fixture": {"style": "blocks", "imsize": 64, "n_train": 128,
+                        "n_test": 32, "epochs": 45, "width_scale": 4},
+            "all_edge_mAP": 0.62, "all_quality_mAP": 0.80,
+            "sweep": [],
+            "selected": {"threshold": 0.31, "escalation_rate": 0.25,
+                         "blended_mAP": blended_map,
+                         "delta_vs_all_quality":
+                             round(blended_map - 0.80, 4)}}
 
 
 def _fleet_fixture(eff4: float, goodput4: float) -> Dict:
@@ -959,6 +1068,36 @@ def selfcheck() -> int:
         check("-1 pt tier mAP wiggle passes",
               run(["--root", tmp, "--ledger", ledger,
                    "--candidate", ok_q]) == 0)
+        # the ISSUE 16 acceptance fixtures: the cascade goodput ratio is
+        # a same-box same-trace ratio in the tight `eff` class, and the
+        # blended mAP gates ABSOLUTE like every quality metric
+        check("cascade goodput ratio tracked in the ledger",
+              "cascade[cpu,64,simq40,sime5,x5].goodput_ratio"
+              in load_ledger(ledger)["entries"])
+        check("cascade blended mAP tracked in the ledger",
+              "cascadecal[cpu,64,blocks,smoke].blended_map"
+              in load_ledger(ledger)["entries"])
+        bad_casc = os.path.join(tmp, "cand_cascade.json")
+        save_json(bad_casc,
+                  _cascade_bench_fixture(round(2.6 * 0.8, 4), 1900.0))
+        check("-20% cascade goodput ratio FAILS the gate",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", bad_casc]) == 1)
+        ok_casc = os.path.join(tmp, "cand_cascade_ok.json")
+        save_json(ok_casc, _cascade_bench_fixture(2.45, 1500.0))
+        check("cascade ratio wiggle + cpu goodput dip pass",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", ok_casc]) == 0)
+        bad_cc = os.path.join(tmp, "cand_casc_calib.json")
+        save_json(bad_cc, _cascade_calib_fixture(round(0.78 - 0.03, 4)))
+        check("-3 pt blended mAP FAILS the gate",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", bad_cc]) == 1)
+        ok_cc = os.path.join(tmp, "cand_casc_calib_ok.json")
+        save_json(ok_cc, _cascade_calib_fixture(round(0.78 - 0.01, 4)))
+        check("-1 pt blended mAP wiggle passes",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", ok_cc]) == 0)
         # within-tolerance chip wiggle and a 30%-slow CPU line both pass
         okc = os.path.join(tmp, "cand_ok.json")
         save_json(okc, {"platform": "tpu", "imsize": 512, "batch": 16,

@@ -50,8 +50,22 @@ chain — the sanctioned timing harness). The artifact
 (schema quality-matrix-v2) is the latency<->mAP Pareto frontier perfgate
 ratchet-gates per tier (the `quality` tolerance class).
 
+`--cascade` (ISSUE 16) calibrates the cascade escalation threshold on
+the SAME tier fixture (and the same /tmp tier checkpoints — a prior
+`--tiers` run's trainings are reused via their DONE markers): the edge
+tier's confidence-summary predict (`make_predict_fn(cascade_summary=
+True)`) and the quality tier's plain predict each score the held-out
+split once, then the threshold sweep blends them per image (escalate iff
+edge confidence < t -> take the quality answer) into an
+escalation-rate vs blended-mAP curve. The chosen operating point — the
+SMALLEST escalation rate whose blended mAP is within 2 pts of
+all-quality routing — lands in `artifacts/<round>/cascade.json` (schema
+cascade-calibration-v1), which `config.cascade_overrides` loads for
+`--cascade` serving exactly the way quant scales artifacts are loaded,
+and perfgate gates in its ABSOLUTE `quality` class.
+
 Usage: python scripts/quality_matrix.py [--epochs N] [--train N] [--test N]
-       [--only row[,row]] [--smoke] [--tiers]
+       [--only row[,row]] [--smoke] [--tiers] [--cascade]
 """
 
 from __future__ import annotations
@@ -419,11 +433,268 @@ def run_tiers(smoke: bool, only) -> None:
                       "out": OUT_PATH}))
 
 
+def run_cascade(smoke: bool) -> None:
+    """`--cascade` (ISSUE 16): escalation-threshold calibration — see
+    module docstring. Shares the tier fixture AND the tier work_root
+    with `--tiers` (trainings are reused through their DONE markers)."""
+    if smoke:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import numpy as np
+
+    from real_time_helmet_detection_tpu.config import (Config, TIER_PRESETS,
+                                                       save_config)
+    from real_time_helmet_detection_tpu.data import (BatchLoader,
+                                                     load_dataset,
+                                                     make_synthetic_voc)
+    from real_time_helmet_detection_tpu.data.voc import boxes_from_voc_dict
+    from real_time_helmet_detection_tpu.evaluate import (_origin_size,
+                                                         load_eval_state)
+    from real_time_helmet_detection_tpu.metrics import compute_map
+    from real_time_helmet_detection_tpu.models import build_model
+    from real_time_helmet_detection_tpu.predict import make_predict_fn
+    from real_time_helmet_detection_tpu.train import train
+
+    epochs = arg("--epochs", 45)
+    n_train = arg("--train", 128 if smoke else 640)
+    n_test = arg("--test", 32 if smoke else 96)
+    imsize = 64 if smoke else 512
+    batch = 4 if smoke else 16
+    style = "blocks" if smoke else "scenes"  # the tier-fixture choice:
+    # smoke scores on blocks (scenes is below the CPU trainable floor —
+    # run_tiers' note); the CURVE SHAPE is the smoke signal
+    max_objects = 4 if smoke else 12
+    wscale = 4 if smoke else 1
+    archs = {
+        name: {"variant": p["variant"], "num_stack": p["num_stack"],
+               "width": max(8, p["hourglass_inch"] // wscale)}
+        for name, p in TIER_PRESETS.items()}
+    data_root = "/tmp/voc_%s_tiers_%d" % (style, imsize)
+    work_root = "/tmp/qmatrix_tiers" + ("_smoke" if smoke else "")
+
+    ds_meta = {"n_train": n_train, "n_test": n_test, "imsize": imsize,
+               "style": style, "max_objects": max_objects}
+    meta_path = os.path.join(data_root, "dataset_meta.json")
+    have = None
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                have = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            have = None
+    if have != ds_meta:
+        if os.path.isdir(data_root):
+            import shutil
+            shutil.rmtree(data_root)
+        log("generating %s dataset (%d train / %d test @%d^2)..."
+            % (style, n_train, n_test, imsize))
+        make_synthetic_voc(data_root, num_train=n_train, num_test=n_test,
+                           imsize=(imsize, imsize),
+                           max_objects=max_objects, seed=42, style=style)
+        save_json(meta_path, ds_meta)
+
+    hb = maybe_job_heartbeat()
+
+    def tier_cfg(name, save, train_mode=True, **kw):
+        a = archs[name]
+        base = dict(
+            train_flag=train_mode, data=data_root, save_path=save,
+            variant=a["variant"], num_stack=a["num_stack"],
+            hourglass_inch=a["width"], stem_width=min(128, a["width"]),
+            num_cls=2, batch_size=batch,
+            amp=True, optim="adam", lr=5e-4,
+            lr_milestone=[int(epochs * 0.5), int(epochs * 0.9)],
+            end_epoch=epochs, device_augment=train_mode,
+            cache_device=train_mode,
+            multiscale_flag=False, multiscale=[imsize, imsize, 64],
+            keep_ckpt=2, ckpt_interval=max(1, epochs // 2),
+            hang_warn_seconds=1200, num_workers=4, print_interval=10,
+            summary=False)
+        base.update(kw)
+        return Config(**base)
+
+    def latest_ckpt(save):
+        cks = [d for d in os.listdir(save) if d.startswith("check_point_")]
+        if not cks:
+            raise RuntimeError("no checkpoint under %s" % save)
+        return os.path.join(save, max(
+            cks, key=lambda d: int(d.rsplit("_", 1)[1])))
+
+    def run_training(save, cfg):
+        marker = os.path.join(save, "TRAIN_DONE")
+        if os.path.exists(marker):
+            log("training %s already complete (marker)" % save)
+            return
+        if os.path.isdir(save) and os.listdir(save):
+            log("partial training at %s; clearing and retraining" % save)
+            import shutil
+            shutil.rmtree(save)
+        os.makedirs(save, exist_ok=True)
+        from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
+        with maybe_tracer().span("train-cascade-tier", save=save) as sp:
+            train(cfg)
+        save_config(cfg, save)
+        atomic_write_bytes(marker, ("wall_s=%.1f\n" % sp.dur_s).encode())
+        log("training %s done in %.0fs" % (save, sp.dur_s))
+        hb.beat("trained %s" % os.path.basename(save))
+
+    # the two cascade endpoints: quality (flagship recipe) and edge
+    # (scratch — the serving edge tier; distillation is --tiers' story)
+    qsave = os.path.join(work_root, "quality")
+    esave = os.path.join(work_root, "edge_scratch")
+    run_training(qsave, tier_cfg("quality", qsave))
+    run_training(esave, tier_cfg("edge", esave))
+
+    def eval_state(name, save):
+        a = archs[name]
+        cfg = Config(train_flag=False, data=data_root, save_path=save,
+                     model_load=latest_ckpt(save), variant=a["variant"],
+                     num_stack=a["num_stack"], hourglass_inch=a["width"],
+                     stem_width=min(128, a["width"]), num_cls=2,
+                     batch_size=batch, imsize=imsize, topk=100,
+                     conf_th=0.01, nms="nms", nms_th=0.5, num_workers=2)
+        model, variables = load_eval_state(cfg)
+        return cfg, model, variables
+
+    ecfg, emodel, evars = eval_state("edge", esave)
+    qcfg, qmodel, qvars = eval_state("quality", qsave)
+    edge_predict = make_predict_fn(emodel, ecfg, normalize=ecfg.pretrained,
+                                   cascade_summary=True)
+    quality_predict = make_predict_fn(qmodel, qcfg,
+                                      normalize=qcfg.pretrained)
+
+    # one pass over the held-out split per tier: dispatch every b1
+    # predict, ONE batched fetch (fetch discipline; masks on the host)
+    dataset, augmentor = load_dataset(ecfg)
+    loader = BatchLoader(dataset, augmentor, batch_size=batch,
+                         pretrained=ecfg.pretrained, num_cls=2,
+                         normalized_coord=ecfg.normalized_coord,
+                         scale_factor=ecfg.scale_factor,
+                         max_boxes=ecfg.max_boxes, shuffle=False,
+                         drop_last=False, num_workers=2, raw=True)
+    images, infos = [], []
+    for b in loader:
+        for j in range(len(b.infos)):
+            images.append(np.asarray(b.image[j]))
+            infos.append(b.infos[j])
+    if hasattr(loader, "close"):
+        loader.close()
+    log("scoring %d held-out images per tier" % len(images))
+
+    def collect(predict, variables):
+        pend = [predict(variables, img[None]) for img in images]
+        return [type(d)(*(np.asarray(leaf[0]) for leaf in d))
+                for d in jax.device_get(pend)]
+
+    edge_rows = collect(edge_predict, evars)
+    hb.beat("edge tier scored")
+    quality_rows = collect(quality_predict, qvars)
+    hb.beat("quality tier scored")
+
+    gt_boxes, gt_labels, dets = {}, {}, {}
+    scale = float(imsize)
+    for k, (info, er, qr) in enumerate(zip(infos, edge_rows,
+                                           quality_rows)):
+        image_id = os.path.splitext(
+            info["annotation"].get("filename") or "%06d" % k)[0]
+        ow, oh = _origin_size(info)
+        gb, gl = boxes_from_voc_dict(info)
+        gt_boxes[image_id], gt_labels[image_id] = gb, gl
+        resc = np.array([ow / scale, oh / scale, ow / scale, oh / scale],
+                        np.float32)
+
+        def host_row(row):
+            keep = row.valid
+            return {"box": row.boxes[keep] * resc,
+                    "cls": row.classes[keep], "score": row.scores[keep]}
+
+        dets[image_id] = {"edge": host_row(er), "quality": host_row(qr),
+                          "confidence": float(er.confidence)}
+
+    def map_of(pick):
+        """mAP of a per-image tier choice (image_id -> 'edge'|'quality')."""
+        m = compute_map(
+            gt_boxes, gt_labels,
+            {k: dets[k][pick(k)]["box"] for k in dets},
+            {k: dets[k][pick(k)]["cls"] for k in dets},
+            {k: dets[k][pick(k)]["score"] for k in dets}, num_cls=2)
+        return round(float(m["map"]), 4)
+
+    map_edge = map_of(lambda k: "edge")
+    map_quality = map_of(lambda k: "quality")
+    confs = {k: dets[k]["confidence"] for k in dets}
+    log("all-edge mAP %.4f, all-quality mAP %.4f, confidence range "
+        "[%.3f, %.3f]" % (map_edge, map_quality, min(confs.values()),
+                          max(confs.values())))
+
+    # the sweep: one candidate threshold per distinct confidence (the
+    # curve's only knees) plus "escalate everything"; large splits thin
+    # to ~33 quantile points so the chip-scale sweep stays bounded
+    cand = sorted(set(confs.values()))
+    cand.append(max(cand) + 1.0)
+    if len(cand) > 33:
+        idx = np.linspace(0, len(cand) - 1, 33).round().astype(int)
+        cand = [cand[i] for i in sorted(set(idx.tolist()))]
+    sweep = []
+    for t in cand:
+        esc = {k for k, c in confs.items() if c < t}
+        row = {"threshold": round(float(t), 6),
+               "escalation_rate": round(len(esc) / len(confs), 4),
+               "blended_mAP": map_of(
+                   lambda k: "quality" if k in esc else "edge")}
+        row["delta_vs_all_quality"] = round(
+            row["blended_mAP"] - map_quality, 4)
+        sweep.append(row)
+        log("t=%.4f: escalation %.0f%%, blended mAP %.4f (%+.4f vs "
+            "all-quality)" % (t, 100 * row["escalation_rate"],
+                              row["blended_mAP"],
+                              row["delta_vs_all_quality"]))
+    hb.beat("threshold sweep done")
+
+    # operating point: SMALLEST escalation rate within 2 pts of
+    # all-quality routing (always satisfiable: rate 1.0 IS all-quality)
+    ok_rows = [r for r in sweep if r["delta_vs_all_quality"] >= -0.02]
+    selected = dict(min(ok_rows, key=lambda r: r["escalation_rate"]))
+    selected["rule"] = ("min escalation rate with blended mAP >= "
+                        "all-quality - 0.02")
+
+    out_path = os.path.join(os.path.dirname(OUT_PATH), "cascade.json")
+    out = {"schema": "cascade-calibration-v1",
+           "platform": jax.default_backend(), "smoke": smoke,
+           "fixture": {"style": style, "imsize": imsize,
+                       "n_train": n_train, "n_test": n_test,
+                       "epochs": epochs, "width_scale": wscale},
+           "tiers": {"edge": dict(archs["edge"]),
+                     "quality": dict(archs["quality"])},
+           "all_edge_mAP": map_edge, "all_quality_mAP": map_quality,
+           "confidence": {
+               "min": round(min(confs.values()), 4),
+               "median": round(float(np.median(list(confs.values()))), 4),
+               "max": round(max(confs.values()), 4)},
+           "sweep": sweep, "selected": selected}
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    save_json(out_path, out, indent=1)
+    log("selected threshold %.4f (escalation %.0f%%, blended mAP %.4f) "
+        "-> %s" % (selected["threshold"],
+                   100 * selected["escalation_rate"],
+                   selected["blended_mAP"], out_path))
+    print(json.dumps({"tool": "quality_matrix", "cascade": True,
+                      "all_edge_mAP": map_edge,
+                      "all_quality_mAP": map_quality,
+                      "selected": selected, "sweep_points": len(sweep),
+                      "out": out_path}))
+
+
 def main() -> None:
     only = None
     for i, a in enumerate(sys.argv):
         if a == "--only" and i + 1 < len(sys.argv):
             only = set(sys.argv[i + 1].split(","))
+
+    if "--cascade" in sys.argv:
+        run_cascade("--smoke" in sys.argv)
+        return
 
     if "--tiers" in sys.argv:
         run_tiers("--smoke" in sys.argv, only)

@@ -71,6 +71,30 @@ for END-TO-END request latency because the result fetch is a real D2H.
   `lost_acks == 0` (the fleet half of the zero-lost-acks invariant).
   The ONE JSON line gains `replicas`/`tenants`/`canary` fields.
 
+* **cascade mode (`--cascade`, ISSUE 16)** — edge-first serving with
+  confidence-gated escalation vs the all-quality status quo, at the SAME
+  offered load over the SAME seeded arrival trace and the SAME total
+  replica count (`serve_bench_cascade.json`, schema
+  **serve-bench-cascade-v1**). Both sides run simulated fixed-service
+  replicas (the CPU-valid signal, exactly as fleet mode's scaling rows):
+  the all-quality baseline is two quality-tier replicas
+  (`--replica-sim-ms` service time); the cascade fleet is one edge
+  replica (`--cascade-edge-ms`, emitting a per-row confidence derived
+  from the image bytes — pixel[0,0,0]/255 — so the seeded pool fixes the
+  escalation mix deterministically) plus one quality replica, routed by
+  FleetRouter's cascade policy at `--cascade-threshold`. Offered load is
+  `--cascade-load`x the measured all-quality capacity (past its
+  saturation by construction): the baseline's goodput pins at its
+  capacity while the cascade fleet keeps answering — the
+  `cascade_goodput_ratio` >= 2.0 gate (`gate_cascade_2x`) is the
+  artifact's headline, ratchet-gated by perfgate in the `eff` class. An
+  escalation-fault replay section (`fleet:escalate` device-loss +
+  worker-death; `--faults` / the `seed=N` shorthand overrides, drawn
+  over the CASCADE sites) pins the degraded-answer contract: a dead or
+  dying quality tier degrades to the in-hand edge answer — flagged,
+  never a lost ack. The ONE JSON line gains
+  `cascade`/`escalation_rate`/`cascade_goodput_ratio` fields.
+
 * **tail exemplars (`--trace-exemplars N`, ISSUE 14)** — the load run
   records trace contexts (obs/trace.py rides the engine/fleet span
   taxonomy; a temp span log is armed automatically when none is
@@ -122,6 +146,7 @@ from real_time_helmet_detection_tpu.utils import save_json  # noqa: E402
 
 SCHEMA = "serve-bench-v1"
 FLEET_SCHEMA = "serve-bench-fleet-v1"
+CASCADE_SCHEMA = "serve-bench-cascade-v1"
 HB = maybe_job_heartbeat()
 
 
@@ -353,6 +378,57 @@ class SimServePredict:
                 return _SimCompiled(b, service_s)
 
         return _Lowered()
+
+
+# cascade sim output: the same fixed-shape per-row block plus the
+# per-row `confidence` leaf the fleet's escalation gate reads — shaped
+# exactly like the real CascadeDetections contract (an extra leaf on the
+# output block, zero extra fetches)
+_SimCascadeDetections = collections.namedtuple(
+    "_SimCascadeDetections", "boxes scores confidence")
+
+
+class _SimCascadeCompiled(_SimCompiled):
+    def __call__(self, variables, images):
+        time.sleep(self.service_s)
+        imgs = np.asarray(images)
+        boxes = imgs[:, :2, :2, 0].astype(np.float32).reshape(self.b, -1)
+        # deterministic per-image confidence from the image bytes: the
+        # seeded uint8 pool fixes the escalation mix exactly
+        conf = imgs[:, 0, 0, 0].astype(np.float32) / 255.0
+        return _SimCascadeDetections(boxes, boxes.sum(axis=1), conf)
+
+
+class SimCascadePredict(SimServePredict):
+    """Edge-tier sim predict: `SimServePredict` plus a per-row
+    `confidence` in [0, 1] read off pixel[0,0,0] of each image —
+    `sim_confidence()` is the host-side oracle, so the realized
+    escalation fraction of a pool is known before the run."""
+
+    def lower(self, variables, spec):
+        b, service_s = spec.shape[0], self.service_s
+
+        class _Lowered:
+            def compile(self):
+                return _SimCascadeCompiled(b, service_s)
+
+        return _Lowered()
+
+    @staticmethod
+    def sim_confidence(img: np.ndarray) -> float:
+        return float(img[0, 0, 0]) / 255.0
+
+
+class _TenantPin:
+    """submit-shim pinning every request to one tenant: the open/closed
+    load loops stay tenant-agnostic while the cascade rows ride the
+    enrolled cascade tenant."""
+
+    def __init__(self, router, tenant: str):
+        self.router, self.tenant = router, tenant
+
+    def submit(self, image, **kw):
+        return self.router.submit(image, tenant=self.tenant, **kw)
 
 
 def make_replica_factory(predict, variables, imsize, buckets,
@@ -666,6 +742,209 @@ def run_fleet_bench(args) -> Dict:
                out.get("exemplar_p99_stage")))
     log("fleet gates: scaling>=0.8 %s, zero lost acks %s"
         % (out["gate_scaling_08"], out["gate_zero_lost_acks"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cascade harness (ISSUE 16)
+
+
+def make_cascade_sim_factory(args, tracer=None):
+    """rid 0 -> edge-tier sim replica (fast service, confidence leaf),
+    rid 1 -> quality-tier sim replica. Both inner factories come from
+    `make_replica_factory` (THE sanctioned construction point — this
+    wrapper only picks between them by rid, the mapping `replica_tiers`
+    mirrors)."""
+    buckets = tuple(sorted(set(args.buckets)))
+    kw = dict(queue_capacity=max(args.queue_cap, 64),
+              max_wait_ms=args.max_wait_ms, depth=args.depth,
+              tracer=tracer)
+    edge_f = make_replica_factory(SimCascadePredict(args.cascade_edge_ms),
+                                  {"w": np.zeros(1)}, args.imsize,
+                                  buckets, **kw)
+    qual_f = make_replica_factory(SimServePredict(args.replica_sim_ms),
+                                  {"w": np.zeros(1)}, args.imsize,
+                                  buckets, **kw)
+
+    def factory(rid, start=True):
+        return (edge_f if rid == 0 else qual_f)(rid, start=start)
+
+    return factory
+
+
+def cascade_fault_run(args, tracer) -> Dict:
+    """The escalation-hop acceptance run: a quality-tier device-loss and
+    a quality-replica worker-death fire mid-cascade (`fleet:escalate`
+    site; everything escalates — threshold above the sim confidence
+    range) and every acknowledged request still answers — the loss
+    degrades to the in-hand edge result (flagged `degraded_answer`),
+    the death respawns and the hop proceeds. lost_acks must be 0."""
+    from real_time_helmet_detection_tpu.runtime.faults import \
+        CASCADE_SITES
+    spec = (args.faults or "").strip()
+    if spec.startswith("seed="):
+        opts = dict(p.split("=", 1) for p in spec.split(",") if "=" in p)
+        sched = FaultSchedule.seeded(int(opts["seed"]),
+                                     n=int(opts.get("n", 2)),
+                                     sites=CASCADE_SITES, max_at=24)
+    elif spec:
+        sched = FaultSchedule.parse(spec)
+    else:
+        sched = FaultSchedule.parse("fleet:escalate=device-loss@2,"
+                                    "fleet:escalate=worker-death@5")
+    inj = ChaosInjector(sched, tracer=tracer)
+    router = FleetRouter(make_cascade_sim_factory(args, tracer), 2,
+                         replica_tiers=list(args.cascade_tiers),
+                         cascade_tenants=["cascade"],
+                         cascade_tiers=tuple(args.cascade_tiers),
+                         cascade_threshold=2.0,  # > sim max: all escalate
+                         metrics=MetricsRegistry(),
+                         default_budget=1_000_000, injector=inj,
+                         tracer=tracer)
+    futs = [router.submit(img, tenant="cascade")
+            for img in _sim_pool(args) * 2]
+    lost = 0
+    for f in futs:
+        try:
+            f.result(timeout=120)
+        except Exception:  # noqa: BLE001 — a lost acknowledged request
+            lost += 1
+    st = router.stats()
+    router.close()
+    out = {"spec": inj.schedule.spec(), "injected": inj.summary(),
+           "requests": len(futs), "lost_acks": lost,
+           "degraded_answers": st["degraded_answers"],
+           "escalated": st["escalated"],
+           "replica_deaths": st["replica_deaths"],
+           "respawns": st["respawns"]}
+    log("cascade faults: %d injected, degraded %d, deaths %d, "
+        "lost acks %d" % (out["injected"]["total"],
+                          out["degraded_answers"],
+                          out["replica_deaths"], out["lost_acks"]))
+    return out
+
+
+def run_cascade_bench(args) -> Dict:
+    """Cascade vs all-quality at the SAME offered load over the SAME
+    seeded arrival trace and the SAME total replica count (module
+    docstring, cascade-mode note). Sections: all-quality capacity
+    (closed loop) -> one overload open-loop row per side -> the
+    escalation-fault replay -> trace completeness over the whole run."""
+    jax, devs = acquire_backend()
+    platform = devs[0].platform
+    log("backend up: %s (cascade mode)" % platform)
+    HB.beat("backend up (%s, cascade)" % platform)
+    from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
+    tracer = arm_trace_log(args, maybe_tracer(args.span_log or None))
+
+    threshold = args.cascade_threshold
+    pool = _sim_pool(args)
+    pool_esc = sum(1 for img in pool
+                   if SimCascadePredict.sim_confidence(img) < threshold) \
+        / len(pool)
+    out: Dict = {"schema": CASCADE_SCHEMA, "tool": "serve_bench",
+                 "platform": platform, "imsize": args.imsize,
+                 "buckets": list(sorted(set(args.buckets))),
+                 "cascade": True,
+                 "cascade_tiers": list(args.cascade_tiers),
+                 "cascade_threshold": threshold,
+                 "edge_sim_ms": args.cascade_edge_ms,
+                 "quality_sim_ms": args.replica_sim_ms,
+                 "cascade_load": args.cascade_load,
+                 "deadline_ms": args.deadline_ms, "seed": args.seed,
+                 "pool_escalation_frac": round(pool_esc, 3),
+                 "note": ("both sides run simulated fixed-service "
+                          "replicas (host waits only — the CPU-valid "
+                          "signal, fleet-mode note); cascade = 1 edge + "
+                          "1 quality replica vs 2 quality replicas, "
+                          "same seeded Poisson trace at the same "
+                          "offered load")}
+    deadline_s = args.deadline_ms / 1e3
+
+    def quality_factory():
+        return make_replica_factory(
+            SimServePredict(args.replica_sim_ms), {"w": np.zeros(1)},
+            args.imsize, tuple(sorted(set(args.buckets))),
+            queue_capacity=max(args.queue_cap, 64),
+            max_wait_ms=args.max_wait_ms, depth=args.depth,
+            tracer=tracer)
+
+    # all-quality baseline: capacity, then one past-saturation row
+    base = FleetRouter(quality_factory(), 2, metrics=MetricsRegistry(),
+                       default_budget=1_000_000, tracer=tracer)
+    try:
+        closed = closed_loop(base, pool, args.clients,
+                             max(2.0, args.duration / 2), tracer=tracer)
+        cap = max(closed["goodput_rps"], 1e-6)
+        out["all_quality_capacity_rps"] = closed["goodput_rps"]
+        log("all-quality capacity: %.1f req/s (2 replicas, closed loop)"
+            % cap)
+        rate = args.cascade_load * cap
+        sched = arrival_schedule(rate, args.duration, args.seed + 616)
+        out["offered_rps"] = round(rate, 2)
+        row_base = open_loop(base, pool, sched, args.duration,
+                             deadline_s, rate)
+    finally:
+        base.close()
+    row_base["mode"] = "all-quality"
+    log("all-quality at %.1f rps offered: goodput %.1f, p99 %s ms, "
+        "shed %d" % (rate, row_base["goodput_rps"], row_base["p99_ms"],
+                     row_base["shed"]))
+    HB.beat("all-quality row done")
+
+    # cascade fleet over the SAME trace (identical schedule object)
+    casc = FleetRouter(make_cascade_sim_factory(args, tracer), 2,
+                       replica_tiers=list(args.cascade_tiers),
+                       cascade_tenants=["cascade"],
+                       cascade_tiers=tuple(args.cascade_tiers),
+                       cascade_threshold=threshold,
+                       metrics=MetricsRegistry(),
+                       default_budget=1_000_000, tracer=tracer)
+    try:
+        row_casc = open_loop(_TenantPin(casc, "cascade"), pool, sched,
+                             args.duration, deadline_s, rate)
+    finally:
+        st = casc.stats()
+        casc.close()
+    row_casc["mode"] = "cascade"
+    hops = max(st["edge_resolved"] + st["escalated"], 1)
+    out["escalation_rate"] = round(st["escalated"] / hops, 4)
+    out["edge_resolved"] = st["edge_resolved"]
+    out["escalated"] = st["escalated"]
+    out["degraded_answers"] = st["degraded_answers"]
+    out["rows"] = [row_casc, row_base]
+    ratio = row_casc["goodput_rps"] / max(row_base["goodput_rps"], 1e-6)
+    out["cascade_goodput_ratio"] = round(ratio, 2)
+    out["gate_cascade_2x"] = bool(ratio >= 2.0)
+    log("cascade at the same %.1f rps: goodput %.1f vs %.1f all-quality "
+        "(%.2fx, escalation rate %.1f%%, gate_cascade_2x=%s)"
+        % (rate, row_casc["goodput_rps"], row_base["goodput_rps"],
+           ratio, 100 * out["escalation_rate"], out["gate_cascade_2x"]))
+    HB.beat("cascade row done")
+
+    out["faults"] = cascade_fault_run(args, tracer)
+    HB.beat("cascade fault run done")
+    out["gate_zero_lost_acks"] = bool(
+        row_casc["lost"] == 0 and row_base["lost"] == 0
+        and out["faults"]["lost_acks"] == 0)
+
+    exemplars, tsummary = trace_sections(tracer, args.trace_exemplars)
+    if exemplars is not None:
+        out["trace_exemplars"] = exemplars
+        out["trace_summary"] = tsummary
+        if exemplars["exemplars"]:
+            out["exemplar_p99_stage"] = \
+                exemplars["exemplars"][0]["critical_path"]["dominant_stage"]
+        out["gate_traces_complete"] = bool(
+            tsummary["orphans"] == 0 and tsummary["broken_chains"] == 0
+            and tsummary["request_traces"] > 0)
+        log("trace gate: %d request traces, orphans %d, broken %d, "
+            "p99 stage %s" % (tsummary["request_traces"],
+                              tsummary["orphans"],
+                              tsummary["broken_chains"],
+                              out.get("exemplar_p99_stage")))
+    log("cascade gates: 2x goodput %s, zero lost acks %s"
+        % (out["gate_cascade_2x"], out["gate_zero_lost_acks"]))
     return out
 
 
@@ -1272,12 +1551,127 @@ def selfcheck() -> int:
         print("selfcheck traces section elapsed %.1fs"
               % sp_tr.close(), file=sys.stderr, flush=True)
 
+        # ---- cascade serving (ISSUE 16): edge-first routing over REAL
+        # predicts — zero lost acks + zero recompiles under the seeded
+        # escalation-hop fault schedule (quality tier dead at the hop ->
+        # degraded EDGE answer, flagged, never lost), bit-identity on
+        # every path ------------------------------------------------------
+        from real_time_helmet_detection_tpu.models import build_model
+        from real_time_helmet_detection_tpu.predict import make_predict_fn
+        sp_c = maybe_tracer(None).span(
+            "serve-bench:selfcheck-cascade").__enter__()
+        edge_predict = make_predict_fn(build_model(cfg), cfg,
+                                       normalize="imagenet",
+                                       cascade_summary=True)
+        # edge oracle incl. the in-jit confidence — dispatch everything,
+        # ONE batched fetch (the engine's own fetch discipline); its det
+        # fields must equal the plain oracle (the summary only ADDS a
+        # leaf), which doubles as the zero-extra-D2H contract check
+        pend_c = [edge_predict(variables, img[None]) for img in pool]
+        edge_oracle = [type(d)(*(np.asarray(leaf[0]) for leaf in d))
+                       for d in jax.device_get(pend_c)]
+        check("cascade: summary predict det-identical to plain predict",
+              all(np.array_equal(getattr(e, name), getattr(o, name))
+                  for e, o in zip(edge_oracle, oracle)
+                  for name in ("boxes", "classes", "scores", "valid")))
+        # fixture operating-point pick, NOT a latency digest: the middle
+        # of the oracle confidence distribution makes both outcomes
+        # (edge-resolve / escalate) happen over the 8-image pool
+        confs = [float(d.confidence) for d in edge_oracle]
+        th_c = float(np.median(confs))  # graftlint: off=raw-metric-aggregation
+
+        def _cascade_factory(rid, start=True):
+            pred = edge_predict if rid == 0 else predict
+            return make_replica_factory(pred, variables, 64, (1, 2, 4),
+                                        queue_capacity=64,
+                                        max_wait_ms=2.0)(rid, start=start)
+
+        injc = ChaosInjector(FaultSchedule.parse(
+            "fleet:escalate=device-loss@2"))
+        frc = FleetRouter(_cascade_factory, 2,
+                          replica_tiers=["edge", "quality"],
+                          cascade_tenants=["cas"],
+                          cascade_tiers=("edge", "quality"),
+                          cascade_threshold=th_c,
+                          metrics=MetricsRegistry(), injector=injc)
+        # warm both tiers through the cascade path itself, then pin zero
+        # recompiles over the faulted stream (both engines AOT-compile
+        # their buckets up front; a cascade hop must never trace afresh)
+        for f in [frc.submit(pool[i], tenant="cas") for i in range(4)]:
+            f.result(timeout=60)
+        counter_c = install_recompile_counter()
+        futc = [(i % len(pool), frc.submit(pool[i % len(pool)],
+                                           tenant="cas"))
+                for i in range(12)]
+        lostc, rowsc = 0, []
+        for i, f in futc:
+            try:
+                rowsc.append((i, f, f.result(timeout=120)))
+            except Exception:  # noqa: BLE001 — would be a lost ack
+                lostc += 1
+        stc = frc.stats()
+        frc.close()
+        check("cascade: escalation-hop fault fired",
+              len(injc.fired) == 1 and injc.pending() == 0)
+        check("cascade: zero lost acks under escalation faults",
+              lostc == 0 and stc["lost"] == 0)
+        check("cascade: zero recompiles across both tiers",
+              counter_c.count == 0)
+        check("cascade: faulted hop degraded to the edge answer",
+              stc["degraded_answers"] >= 1
+              and all(_rows_equal_sc(r, edge_oracle[i])
+                      for i, f, r in rowsc if f.degraded_answer))
+        check("cascade: every answer bit-identical to its oracle",
+              all(_rows_equal_sc(r, oracle[i]) for i, f, r in rowsc))
+        check("cascade: edge answers carry the in-jit confidence",
+              all(np.array_equal(r.confidence, edge_oracle[i].confidence)
+                  for i, f, r in rowsc
+                  if not f.escalated or f.degraded_answer))
+        check("cascade: outcome follows the confidence vs threshold",
+              all(f.escalated == (confs[i] < th_c)
+                  for i, f, r in rowsc if not f.degraded_answer))
+
+        # quality-replica worker-death mid-cascade: respawn + the hop
+        # proceeds (or degrades) — the ack is never lost (recompiles NOT
+        # pinned here: a respawned engine legitimately re-AOTs)
+        injd = ChaosInjector(FaultSchedule.parse(
+            "fleet:escalate=worker-death@2"))
+        frd = FleetRouter(_cascade_factory, 2,
+                          replica_tiers=["edge", "quality"],
+                          cascade_tenants=["cas"],
+                          cascade_tiers=("edge", "quality"),
+                          cascade_threshold=1e9,  # everything escalates
+                          metrics=MetricsRegistry(), injector=injd)
+        futd = [(i % len(pool), frd.submit(pool[i % len(pool)],
+                                           tenant="cas"))
+                for i in range(6)]
+        lostd = 0
+        for i, f in futd:
+            try:
+                f.result(timeout=120)
+            except Exception:  # noqa: BLE001 — would be a lost ack
+                lostd += 1
+        std = frd.stats()
+        frd.close()
+        check("cascade: quality death respawned, zero lost acks",
+              lostd == 0 and std["lost"] == 0
+              and std["replica_deaths"] == 1 and std["respawns"] == 1)
+        print("selfcheck cascade section elapsed %.1fs"
+              % sp_c.close(), file=sys.stderr, flush=True)
+
     ok = not failures
     print(json.dumps({"tool": "serve_bench", "selfcheck": True, "ok": ok,
                       "failures": failures,
                       "elapsed_s": round(sp_all.close(), 1)}))
     sys.stdout.flush()
     return 0 if ok else 1
+
+
+def _rows_equal_sc(row, oracle_row) -> bool:
+    """Det-field bit-identity (the confidence leaf, when present on both
+    sides, is checked separately — a plain-predict oracle has none)."""
+    return all(np.array_equal(getattr(row, n), getattr(oracle_row, n))
+               for n in ("boxes", "classes", "scores", "valid"))
 
 
 def _raises_shed(fut) -> bool:
@@ -1345,6 +1739,33 @@ def main(argv=None) -> int:
                    help="fleet rows' offered load as a multiple of "
                         "N x per-replica capacity (the past-saturation "
                         "point the 0.8x scaling gate is claimed at)")
+    p.add_argument("--cascade", action="store_true",
+                   help="cascade mode (ISSUE 16): edge-first serving "
+                        "with confidence-gated escalation vs all-quality "
+                        "routing at the same offered load over the same "
+                        "seeded arrival trace; writes the "
+                        "serve-bench-cascade-v1 artifact "
+                        "(serve_bench_cascade.json)")
+    p.add_argument("--cascade-threshold", type=float, default=0.1,
+                   help="cascade escalation threshold on the SIM "
+                        "confidence scale (pixel[0,0,0]/255 in [0,1]; "
+                        "~the escalation fraction of a uniform pool). "
+                        "Real-parts serving resolves its threshold from "
+                        "the calibrated quality_matrix --cascade "
+                        "artifact via config.cascade_overrides instead")
+    p.add_argument("--cascade-tiers", nargs=2, default=["edge", "quality"],
+                   metavar=("EDGE", "QUALITY"),
+                   help="the (edge, quality) tier pair the cascade spans")
+    p.add_argument("--cascade-edge-ms", type=float, default=5.0,
+                   help="edge-tier simulated service time (quality tier "
+                        "uses --replica-sim-ms)")
+    p.add_argument("--cascade-load", type=float, default=5.0,
+                   help="cascade rows' offered load as a multiple of the "
+                        "measured all-quality CLOSED-loop capacity (a "
+                        "client-bound underestimate of the open-loop "
+                        "ceiling — keep well past it: the "
+                        "gate_cascade_2x headline is claimed at an "
+                        "offered load the baseline saturates under)")
     p.add_argument("--tenants", default="bulk:64,flagged:64",
                    help="fleet canary run's tenant mix as "
                         "'name:budget,...' (per-tenant counters ride "
@@ -1396,7 +1817,12 @@ def main(argv=None) -> int:
         name, _, budget = part.partition(":")
         args.tenant_budgets[name] = int(budget or 64)
 
-    if args.replicas:
+    if args.cascade:
+        out = run_cascade_bench(args)
+        path = args.out or os.path.join(REPO, "artifacts", graft_round(),
+                                        "serving",
+                                        "serve_bench_cascade.json")
+    elif args.replicas:
         out = run_fleet_bench(args)
         path = args.out or os.path.join(REPO, "artifacts", graft_round(),
                                         "serving",

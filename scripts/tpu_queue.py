@@ -92,6 +92,8 @@ def cmd_run(args) -> int:
 
 
 def cmd_status(args) -> int:
+    if getattr(args, "summary", False):
+        return cmd_status_summary(args)
     spool = Spool(args.queue_dir)
     rows = [{"job": js.spec.job, "state": js.state, "attempt": js.attempt,
              "not_before": js.not_before or None,
@@ -99,6 +101,73 @@ def cmd_status(args) -> int:
             for js in spool.ordered()]
     spool.close()
     print(json.dumps({"queue_dir": spool.root, "jobs": rows}, indent=1))
+    return 0
+
+
+def _journal_census(path: str):
+    """Read-only tolerant census of one queue journal: last state per
+    job + salvage evidence. Never opens a Spool (Spool's constructor
+    repairs torn tails IN PLACE — a census across other rounds' committed
+    queues must not rewrite them); torn/junk lines are dropped, exactly
+    like obs_report's journal reader."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    jobs: dict = {}
+    salvaged = set()
+    dropped = 0
+    for ln in data.splitlines():
+        if not ln.strip():
+            continue
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError:
+            dropped += 1
+            continue
+        kind, job = rec.get("kind"), rec.get("job")
+        if kind == "spec" and job:
+            jobs.setdefault(job, "queued")
+        elif kind == "state" and job in jobs and rec.get("state"):
+            jobs[job] = rec["state"]
+            if rec["state"] == "salvaged":
+                # salvage is a waypoint (salvaged -> failed/queued), so
+                # count it separately from the terminal state
+                salvaged.add(job)
+    by_state: dict = {}
+    for st in jobs.values():
+        by_state[st] = by_state.get(st, 0) + 1
+    return {"jobs": len(jobs), "by_state": dict(sorted(by_state.items())),
+            "salvaged": len(salvaged), "dropped_lines": dropped}
+
+
+def cmd_status_summary(args) -> int:
+    """`status --summary` (ISSUE 16): one-screen census across EVERY
+    round's queue (artifacts/*/queue/jobs.jsonl) — queued/running/failed/
+    done/salvaged counts per round, so a backlog triage (r08-r15 style)
+    reads one table instead of N per-round status dumps."""
+    import glob as _glob
+    rounds = {}
+    for path in sorted(_glob.glob(os.path.join(
+            REPO, "artifacts", "*", "queue", "jobs.jsonl"))):
+        rnd = os.path.basename(os.path.dirname(os.path.dirname(path)))
+        census = _journal_census(path)
+        if census is not None:
+            rounds[rnd] = census
+    if rounds:
+        states = sorted({s for c in rounds.values() for s in c["by_state"]})
+        hdr = ["round", "jobs"] + states + ["salvaged"]
+        print("  ".join("%-9s" % h for h in hdr), file=sys.stderr)
+        for rnd, c in rounds.items():
+            row = [rnd, str(c["jobs"])]
+            row += [str(c["by_state"].get(s, 0)) for s in states]
+            row += [str(c["salvaged"])]
+            print("  ".join("%-9s" % v for v in row), file=sys.stderr)
+    else:
+        print("no round queues under artifacts/*/queue", file=sys.stderr)
+    print(json.dumps({"tool": "tpu_queue", "summary": True,
+                      "rounds": rounds}))
     return 0
 
 
@@ -271,7 +340,10 @@ def main(argv=None) -> int:
     pr.add_argument("--park-retry-s", type=float, default=60.0)
     pr.add_argument("--waiter-retry-s", type=float, default=120.0)
 
-    sub.add_parser("status", help="print the spool state as JSON")
+    ps = sub.add_parser("status", help="print the spool state as JSON")
+    ps.add_argument("--summary", action="store_true",
+                    help="one-screen census across ALL rounds' queues "
+                         "(read-only; journals are never repaired)")
 
     # the job command sits after a literal `--` (argparse's REMAINDER is
     # greedy and would swallow enqueue's own options; splitting by hand

@@ -219,6 +219,24 @@ def test_fleet_sites_registered_and_seedable():
     assert all(e.site in FLEET_SITES for e in a)
 
 
+def test_cascade_site_registered_and_seedable():
+    """ISSUE 16: the fleet:escalate chaos site is first-class — in
+    ALL_SITES with its two hop-fault kinds (device-loss -> the quality
+    hop errors as it launches -> degrade; worker-death -> the selected
+    quality replica dies -> respawn + the hop proceeds), and seeded
+    schedules draw it replayably like every other site."""
+    from real_time_helmet_detection_tpu.runtime.faults import (
+        ALL_SITES, CASCADE_SITES, SITE_KINDS)
+    assert CASCADE_SITES == ("fleet:escalate",)
+    assert set(CASCADE_SITES) <= set(ALL_SITES)
+    assert set(SITE_KINDS["fleet:escalate"]) == {"device-loss",
+                                                 "worker-death"}
+    a = FaultSchedule.seeded(11, n=3, sites=CASCADE_SITES)
+    assert a.spec() == FaultSchedule.seeded(11, n=3,
+                                            sites=CASCADE_SITES).spec()
+    assert all(e.site == "fleet:escalate" for e in a)
+
+
 def test_fleet_replica_death_acceptance(serve_parts):
     """THE fleet acceptance row: an injected fleet:replica worker-death
     plus a fleet:dispatch device-loss against a live 2-replica router

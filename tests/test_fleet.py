@@ -469,6 +469,187 @@ def test_replica_death_requeues_and_respawns(parts, seed):
     assert len(inj.fired) == len(sched)
 
 
+# ---------------------------------------------------------------------------
+# cascade serving (ISSUE 16): edge-first with confidence-gated escalation
+
+
+@pytest.fixture(scope="module")
+def cascade_parts(parts):
+    """Two-tier cascade fleet parts over the module predict program:
+    rid 0 = edge tier running the confidence-summary predict, rid 1 =
+    quality tier running the plain predict on distinct weights, plus the
+    per-image oracles + confidences for threshold control."""
+    from real_time_helmet_detection_tpu.config import Config as _Cfg
+    from real_time_helmet_detection_tpu.models import build_model as _bm
+    _, variables, new_vars, pool, _, _ = parts
+    cfg = _Cfg(num_stack=1, hourglass_inch=8, num_cls=2, topk=16,
+               conf_th=0.0, nms_th=0.5, imsize=IMSIZE)
+    model = _bm(cfg)
+    edge_predict = make_predict_fn(model, cfg, normalize="imagenet",
+                                   cascade_summary=True)
+    quality_predict = make_predict_fn(model, cfg, normalize="imagenet")
+    edge_oracle = _oracle_of(edge_predict, variables, pool)
+    quality_oracle = _oracle_of(quality_predict, new_vars, pool)
+    confidences = [float(d.confidence) for d in edge_oracle]
+    return (edge_predict, quality_predict, variables, new_vars, pool,
+            edge_oracle, quality_oracle, confidences)
+
+
+def _cascade_factory(edge_predict, quality_predict, edge_vars,
+                     quality_vars, injector_for=None):
+    """rid 0 -> edge (confidence-summary predict), rid 1 -> quality."""
+    def factory(rid, start=True):
+        inj = None
+        if injector_for and rid in injector_for:
+            inj = ChaosInjector(FaultSchedule.parse(injector_for[rid]))
+        predict, variables = ((edge_predict, edge_vars) if rid == 0
+                              else (quality_predict, quality_vars))
+        return ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3),
+                             np.uint8, buckets=BUCKETS, max_wait_ms=1.0,
+                             depth=2, queue_capacity=64, max_retries=4,
+                             metrics=MetricsRegistry(), injector=inj,
+                             start=start)
+
+    return factory
+
+
+def _cascade_router(cascade_parts, threshold, injector=None, **kw):
+    edge_predict, quality_predict, variables, new_vars = cascade_parts[:4]
+    return FleetRouter(
+        _cascade_factory(edge_predict, quality_predict, variables,
+                         new_vars),
+        2, replica_tiers=["edge", "quality"],
+        cascade_tenants=["cas"], cascade_tiers=("edge", "quality"),
+        cascade_threshold=threshold, metrics=MetricsRegistry(),
+        injector=injector, **kw)
+
+
+def test_cascade_edge_resolve_bit_identity(cascade_parts):
+    """Threshold below every confidence: nothing escalates, every result
+    is bit-identical to a direct edge-tier submit (including the
+    confidence leaf), and the edge_resolved counter accounts for all."""
+    pool, edge_oracle = cascade_parts[4], cascade_parts[5]
+    router = _cascade_router(cascade_parts, threshold=-100.0)
+    futs = [(i, router.submit(pool[i], tenant="cas"))
+            for i in range(len(pool))]
+    rows = [(i, f.result(timeout=60)) for i, f in futs]
+    direct = [(i, router.submit(pool[i], tenant="cas", tier="edge"))
+              for i in range(len(pool))]
+    direct_rows = [(i, f.result(timeout=60)) for i, f in direct]
+    st = router.stats()
+    router.close()
+    assert all(not f.escalated and not f.degraded_answer
+               for _, f in futs)
+    for i, r in rows:
+        assert _rows_equal(r, edge_oracle[i])
+        assert np.array_equal(r.confidence, edge_oracle[i].confidence)
+    # an explicit tier pin opts out of the cascade and matches exactly
+    for (i, r), (_, d) in zip(rows, direct_rows):
+        assert _rows_equal(r, d)
+    assert st["edge_resolved"] == len(pool)
+    assert st["escalated"] == 0 and st["degraded_answers"] == 0
+    assert st["lost"] == 0
+
+
+def test_cascade_escalation_bit_identity(cascade_parts):
+    """Threshold above every confidence: everything escalates; the
+    escalated result is bit-identical to a direct quality-tier submit,
+    futures carry escalated=True/degraded=False, completion fires once
+    per request."""
+    pool, quality_oracle = cascade_parts[4], cascade_parts[6]
+    router = _cascade_router(cascade_parts, threshold=100.0)
+    futs = [(i, router.submit(pool[i], tenant="cas"))
+            for i in range(len(pool))]
+    rows = [(i, f.result(timeout=60)) for i, f in futs]
+    st = router.stats()
+    h = router.health()
+    router.close()
+    assert all(f.escalated and not f.degraded_answer for _, f in futs)
+    assert all(_rows_equal(r, quality_oracle[i]) for i, r in rows)
+    assert st["escalated"] == len(pool)
+    assert st["edge_resolved"] == 0 and st["degraded_answers"] == 0
+    assert st["completed"] == len(pool) and st["lost"] == 0
+    assert h["cascade"] == {"tiers": ["edge", "quality"],
+                            "threshold": 100.0, "tenants": ["cas"]}
+
+
+def test_cascade_mixed_threshold_routes_by_confidence(cascade_parts):
+    """A mid-range threshold splits the pool: each request's outcome
+    (edge answer vs quality answer, escalated flag) follows its own
+    in-jit confidence against the threshold exactly."""
+    pool, edge_oracle, quality_oracle, confidences = cascade_parts[4:]
+    th = float(np.median(confidences))
+    if not any(c < th for c in confidences) \
+            or not any(c >= th for c in confidences):
+        pytest.skip("degenerate confidence spread on this seed")
+    router = _cascade_router(cascade_parts, threshold=th)
+    futs = [(i, router.submit(pool[i], tenant="cas"))
+            for i in range(len(pool))]
+    rows = [(i, f, f.result(timeout=60)) for i, f in futs]
+    st = router.stats()
+    router.close()
+    for i, f, r in rows:
+        if confidences[i] >= th:
+            assert not f.escalated
+            assert _rows_equal(r, edge_oracle[i])
+        else:
+            assert f.escalated
+            assert _rows_equal(r, quality_oracle[i])
+    want = sum(1 for c in confidences if c < th)
+    assert st["escalated"] == want
+    assert st["edge_resolved"] == len(pool) - want
+    assert st["lost"] == 0 and st["degraded_answers"] == 0
+
+
+def test_cascade_degraded_answer_on_escalation_fault(cascade_parts):
+    """An injected fleet:escalate device-loss (the quality tier erroring
+    as the hop launches) degrades to the in-hand EDGE answer — flagged
+    degraded_answer, never a lost ack, never an exception."""
+    pool, edge_oracle = cascade_parts[4], cascade_parts[5]
+    inj = ChaosInjector(FaultSchedule.parse(
+        "fleet:escalate=device-loss@1"))
+    router = _cascade_router(cascade_parts, threshold=100.0,
+                             injector=inj)
+    futs = [(i, router.submit(pool[i], tenant="cas")) for i in range(4)]
+    rows = [(i, f, f.result(timeout=60)) for i, f in futs]
+    st = router.stats()
+    router.close()
+    degraded = [(i, f, r) for i, f, r in rows if f.degraded_answer]
+    assert len(degraded) == 1  # exactly the injected hop
+    i, f, r = degraded[0]
+    assert f.escalated
+    assert _rows_equal(r, edge_oracle[i])
+    assert st["degraded_answers"] == 1
+    assert st["completed"] == 4 and st["lost"] == 0
+    assert len(inj.fired) == 1
+
+
+def test_cascade_escalation_survives_quality_replica_death(cascade_parts):
+    """A fleet:escalate worker-death kills the SELECTED quality replica
+    mid-cascade; the hop proceeds through the respawn (or degrades) —
+    either way the ack is never lost and every answer is one of the two
+    oracles."""
+    pool, edge_oracle, quality_oracle = cascade_parts[4:7]
+    inj = ChaosInjector(FaultSchedule.parse(
+        "fleet:escalate=worker-death@2"))
+    router = _cascade_router(cascade_parts, threshold=100.0,
+                             injector=inj)
+    futs = [(i % len(pool), router.submit(pool[i % len(pool)],
+                                          tenant="cas"))
+            for i in range(6)]
+    rows = [(i, f, f.result(timeout=120)) for i, f in futs]
+    st = router.stats()
+    router.close()
+    for i, f, r in rows:
+        assert _rows_equal(r, edge_oracle[i]) \
+            or _rows_equal(r, quality_oracle[i])
+        if not f.degraded_answer:
+            assert _rows_equal(r, quality_oracle[i])
+    assert st["lost"] == 0
+    assert st["replica_deaths"] == 1 and st["respawns"] == 1
+    assert len(inj.fired) == 1
+
+
 def test_single_replica_fleet_survives_death(parts):
     """The hardest respawn case: a ONE-replica fleet whose only replica
     dies must re-dispatch the killed requests onto the respawned engine

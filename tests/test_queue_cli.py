@@ -165,6 +165,55 @@ def test_cli_default_queue_dir_is_round_scoped(monkeypatch):
         os.path.join("artifacts", "r99", "queue"))
 
 
+def test_cli_status_summary_cross_round_census(tmp_path, capsys,
+                                               monkeypatch):
+    """`status --summary` (ISSUE 16): read-only census across every
+    round's journal — last state per job wins, salvage waypoints are
+    counted separately, torn tails are dropped, and the journals are
+    NEVER rewritten (no Spool tail repair)."""
+    cli = _load_cli()
+    monkeypatch.setattr(cli, "REPO", str(tmp_path))
+
+    def journal(rnd, lines):
+        qdir = tmp_path / "artifacts" / rnd / "queue"
+        qdir.mkdir(parents=True)
+        path = qdir / "jobs.jsonl"
+        path.write_bytes(b"".join(lines))
+        return path
+
+    j = json.dumps
+    p08 = journal("r08", [
+        (j({"kind": "spec", "job": "bench"}) + "\n").encode(),
+        (j({"kind": "spec", "job": "sweep"}) + "\n").encode(),
+        (j({"kind": "state", "job": "sweep", "state": "salvaged",
+            "t": 1.0, "attempt": 1}) + "\n").encode(),
+        (j({"kind": "state", "job": "sweep", "state": "failed",
+            "t": 2.0, "attempt": 1}) + "\n").encode(),
+        b'{"kind": "state", "job": "bench", "sta',  # torn tail
+    ])
+    p09 = journal("r09", [
+        (j({"kind": "spec", "job": "curve"}) + "\n").encode(),
+        (j({"kind": "state", "job": "curve", "state": "done",
+            "t": 3.0, "attempt": 1}) + "\n").encode(),
+        (j({"kind": "note", "msg": "ignored"}) + "\n").encode(),
+    ])
+    before = (p08.read_bytes(), p09.read_bytes())
+
+    assert cli.main(["status", "--summary"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["summary"] is True
+    r08 = payload["rounds"]["r08"]
+    assert r08["jobs"] == 2
+    assert r08["by_state"] == {"failed": 1, "queued": 1}
+    assert r08["salvaged"] == 1
+    assert r08["dropped_lines"] == 1
+    assert payload["rounds"]["r09"] == {
+        "jobs": 1, "by_state": {"done": 1}, "salvaged": 0,
+        "dropped_lines": 0}
+    # the census must be read-only: journal bytes are untouched
+    assert (p08.read_bytes(), p09.read_bytes()) == before
+
+
 # --------------------------------------------------------------------------
 # the end-to-end proof: real subprocesses through the whole state machine
 # --------------------------------------------------------------------------

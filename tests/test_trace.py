@@ -250,6 +250,104 @@ def test_redispatch_hop_visible_and_chain_complete(tmp_path):
         assert len(dispatches) >= 2  # the hop is visible: two replicas
 
 
+_SimCascadeDetections = collections.namedtuple(
+    "_SimCascadeDetections", "boxes scores confidence")
+
+
+class _SimCascadePredict(SimPredict):
+    """Sim edge predict with a per-image confidence leaf (mean/255), so
+    the router's confidence gate routes deterministically on the image
+    bytes — bright pool images resolve at edge, dark ones escalate."""
+
+    def lower(self, variables, spec):
+        base = SimPredict.lower(self, variables, spec)
+
+        class _L:
+            def compile(self):
+                plain = base.compile()
+
+                def run(variables, images):
+                    det = plain(variables, images)
+                    conf = (np.asarray(images).mean(axis=(1, 2, 3))
+                            .astype(np.float32) / 255.0)
+                    return _SimCascadeDetections(det.boxes, det.scores,
+                                                 conf)
+
+                return run
+
+        return _L()
+
+
+def test_cascade_two_hop_trace_integrity(tmp_path):
+    """ISSUE 16 acceptance shape: an escalated cascade request keeps
+    BOTH hops under ONE trace id — the edge dispatch, the
+    fleet:escalate hop marker, the quality dispatch and exactly one
+    fleet:e2e closure all reassemble into one complete causal chain
+    with zero orphans and zero broken chains; edge-resolved requests
+    stay single-hop."""
+    path = str(tmp_path / "spans.jsonl")
+    tracer = SpanTracer(path)
+    rng = np.random.default_rng(1)
+
+    def img(level):
+        jitter = rng.integers(0, 8, (IMSIZE, IMSIZE, 3), dtype=np.uint8)
+        return (jitter + level).astype(np.uint8)
+
+    # conf = mean/255: level 200 -> ~0.8 (edge-resolves), 20 -> ~0.09
+    pool = [img(200), img(20), img(200), img(20)]
+
+    def factory(rid, start=True):
+        svc = _SimCascadePredict(5.0) if rid == 0 else SimPredict(5.0)
+        return ServingEngine(svc, {"w": np.zeros(1)},
+                             (IMSIZE, IMSIZE, 3), np.uint8,
+                             buckets=(1, 2), max_wait_ms=1.0,
+                             queue_capacity=64,
+                             metrics=MetricsRegistry(), tracer=tracer,
+                             start=start)
+
+    router = FleetRouter(factory, 2, replica_tiers=["edge", "quality"],
+                         cascade_tenants=["cas"],
+                         cascade_tiers=("edge", "quality"),
+                         cascade_threshold=0.5,
+                         metrics=MetricsRegistry(), tracer=tracer)
+    futs = [router.submit(pool[k % 4], tenant="cas") for k in range(8)]
+    for f in futs:
+        f.result(timeout=60)
+    st = router.stats()
+    router.close()
+    tracer.close()
+    assert [f.escalated for f in futs] == [False, True] * 4
+    assert st["escalated"] == 4 and st["edge_resolved"] == 4
+    assert st["degraded_answers"] == 0 and st["lost"] == 0
+
+    traces = traceview.assemble(read_spans(path))
+    summary = traceview.analyze(traces)
+    assert summary["request_traces"] == 8
+    assert summary["orphans"] == 0, summary["orphan_ids"]
+    assert summary["broken_chains"] == 0, summary["broken_detail"]
+    esc, edge = [], []
+    for t in traces.values():
+        names = [r.get("name") for r in t.records]
+        if "fleet:e2e" not in names:
+            continue  # step/aux traces
+        assert names.count("fleet:e2e") == 1  # completion fires ONCE
+        (esc if "fleet:escalate" in names else edge).append(t)
+    assert len(esc) == 4 and len(edge) == 4
+    for t in esc:
+        names = [r.get("name") for r in t.records]
+        # both hops visible under the one trace id
+        assert names.count("fleet:dispatch") == 2
+        ev = next(r for r in t.records
+                  if r.get("name") == "fleet:escalate")
+        assert ev["meta"]["threshold"] == 0.5
+        assert ev["meta"]["confidence"] < 0.5
+        assert t.root_closure() is not None
+    for t in edge:
+        names = [r.get("name") for r in t.records]
+        assert names.count("fleet:dispatch") == 1
+        assert "fleet:escalate" not in names
+
+
 def test_shed_and_failure_close_their_traces(tmp_path):
     """Terminal outcomes are closures too: a queue-full shed on a paused
     standalone engine and a retry-exhausted failure both end their
