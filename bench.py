@@ -79,12 +79,12 @@ DEFAULT_HBM = 819e9  # v5e
 # and runner_drive.py (they diverged in r5: mfu_breakdown defaulted to r05
 # while the rest stayed at r04, scattering same-round artifacts — ADVICE
 # r5 #3); bump it here when a new round starts, or override per-run with
-# $GRAFT_ROUND. r16 = the cascade-serving round (ISSUE 16: edge-first
-# fleet routing with confidence-gated escalation, quality_matrix
-# --cascade calibration + serve_bench --cascade goodput evidence);
-# earlier rounds' artifact dirs are committed history and must not be
-# overwritten.
-GRAFT_ROUND_DEFAULT = "r16"
+# $GRAFT_ROUND. r17 = the streaming-video round (ISSUE 17: per-stream
+# delta-gated tile inference — serving/streams.py sessions over the
+# fleet, quality_matrix --streams skip-threshold calibration +
+# serve_bench --streams goodput evidence); earlier rounds' artifact dirs
+# are committed history and must not be overwritten.
+GRAFT_ROUND_DEFAULT = "r17"
 
 # The arch fields every bench line carries (ISSUE 13): the residual-block
 # variant, stack count, width and the resolved tier name. Pre-tier lines
@@ -115,6 +115,23 @@ def bench_cascade_of(rec: dict) -> dict:
     """The (cascade, escalation_rate) of a bench JSON line; pre-cascade
     lines parse as cascade-off (regression-tested like the arch fields)."""
     return {k: rec.get(k, v) for k, v in CASCADE_DEFAULTS.items()}
+
+
+# The stream fields (ISSUE 17): whether the line carried the delta-gated
+# streaming probe, the fraction of tiles the calibrated threshold would
+# skip on the probe's synthetic stream, and the gated-loop fps estimate.
+# Pre-stream lines lack them — `bench_stream_of` parses ANY line into
+# the full dict, defaulting to stream-off (same back-compat contract as
+# bench_arch_of / bench_cascade_of).
+STREAM_DEFAULTS = {"stream": False, "tile_skip_rate": None,
+                   "stream_fps": None}
+
+
+def bench_stream_of(rec: dict) -> dict:
+    """The (stream, tile_skip_rate, stream_fps) of a bench JSON line;
+    pre-stream lines parse as stream-off (regression-tested like the
+    tier/cascade fields)."""
+    return {k: rec.get(k, v) for k, v in STREAM_DEFAULTS.items()}
 
 # v5e int8 MXU peak (2x the bf16 peak — jax-ml scaling-book): the
 # denominator for int8-path MFU and the hardware case for --infer-dtype
@@ -279,7 +296,10 @@ def find_last_tpu_result(repo_root: str | None = None) -> dict | None:
             "variant", "num_stack", "width", "tier",
             # cascade fields (ISSUE 16): absent on pre-cascade lines —
             # the consumer parses via bench_cascade_of (cascade-off)
-            "cascade", "escalation_rate")
+            "cascade", "escalation_rate",
+            # stream fields (ISSUE 17): absent on pre-stream lines —
+            # the consumer parses via bench_stream_of (stream-off)
+            "stream", "tile_skip_rate", "stream_fps")
     out.update({k: rec[k] for k in keep if k in rec})
     return out
 
@@ -731,6 +751,91 @@ def _bench(out: dict, hb) -> None:
         except Exception as e:  # noqa: BLE001
             log("serve bench failed: %r" % e)
         hb.beat("serve section done")
+
+    # --- delta-gated streaming probe (--stream / BENCH_STREAM=1) ----------
+    # ISSUE 17: two numbers for the ONE JSON line, both OFF the timed
+    # chain above. tile_skip_rate = fraction of tiles the resolved skip
+    # threshold ($BENCH_STREAM_THRESHOLD, else the newest committed
+    # calibration artifact via config.stream_overrides — never a
+    # hand-picked constant) marks static on a seeded synthetic camera
+    # stream (each tile re-randomizes with prob 0.25 per frame — the
+    # serve_bench --streams default redundancy). stream_fps = delivered
+    # frames/s of a gated StreamSession over a small ServingEngine on
+    # that same stream, read from the session's own stats() clock — a
+    # goodput-style figure amortized over the run (like serve_goodput),
+    # NOT a per-call timing. The real offered-load curves are
+    # scripts/serve_bench.py --streams; pre-stream lines parse via
+    # bench_stream_of (stream-off).
+    stream_on = (os.environ.get("BENCH_STREAM") == "1"
+                 or "--stream" in sys.argv)
+    out["stream"] = stream_on
+    if stream_on:
+        try:
+            from real_time_helmet_detection_tpu.ops.delta import (
+                tile_delta_summary, tile_origins)
+            from real_time_helmet_detection_tpu.serving import (
+                ServingEngine, StreamSession)
+            th_env = os.environ.get("BENCH_STREAM_THRESHOLD")
+            if th_env is not None:
+                stream_th = float(th_env)
+            else:
+                from real_time_helmet_detection_tpu.config import (
+                    stream_overrides)
+                stream_th = float(stream_overrides()["stream_threshold"])
+            out["stream_threshold"] = stream_th
+
+            grid = 2
+            fshape = (grid * imsize, grid * imsize, 3)
+            n_frames = int(os.environ.get("BENCH_STREAM_FRAMES", "8"))
+            srng = np.random.default_rng(17)
+            origins = tile_origins(fshape, grid)
+            frames = [srng.integers(0, 256, fshape, dtype=np.uint8)]
+            for _ in range(n_frames - 1):
+                nxt = frames[-1].copy()
+                for (y0, x0) in origins:
+                    if srng.random() >= 0.75:  # this tile changes
+                        nxt[y0:y0 + imsize, x0:x0 + imsize] = srng.integers(
+                            0, 256, (imsize, imsize, 3), dtype=np.uint8)
+                frames.append(nxt)
+            # consecutive-pair delta summaries (this also warms the delta
+            # program the session reuses, so compile stays off its clock)
+            summaries = np.stack([
+                np.asarray(tile_delta_summary(
+                    jnp.asarray(a), jnp.asarray(b), grid=grid))
+                for a, b in zip(frames, frames[1:])])
+            out["tile_skip_rate"] = round(
+                float(np.mean(summaries < stream_th)), 4)
+
+            stpredict = make_predict_fn(model, cfg, normalize="imagenet")
+            with tracer.span("bench:stream-compile"):
+                stengine = ServingEngine(
+                    stpredict, variables, (imsize, imsize, 3), np.uint8,
+                    buckets=(1, 2, 4), max_wait_ms=2.0, depth=2,
+                    queue_capacity=4 * grid * grid, tracer=tracer)
+            try:
+                stengine.predict_many(  # warm the tile-shaped buckets
+                    [np.ascontiguousarray(frames[0][:imsize, :imsize])])
+                sess = StreamSession(
+                    stengine, fshape, grid=grid, threshold=stream_th,
+                    tracer=tracer)
+                for f in frames:
+                    sess.submit_frame(f)
+                sess.drain(timeout=300.0)
+                st = sess.stats()
+                sess.close()
+            finally:
+                stengine.close()
+            out["stream_fps"] = st["fps"]
+            log("stream: %s fps gated (skip rate %.3f at threshold %.4f, "
+                "%d frames)" % (out["stream_fps"], out["tile_skip_rate"],
+                                stream_th, n_frames))
+        except FileNotFoundError:
+            log("stream: no calibration artifact and no "
+                "$BENCH_STREAM_THRESHOLD; tile_skip_rate/stream_fps "
+                "omitted")
+        except Exception as e:  # noqa: BLE001
+            log("stream bench failed: %r" % e)
+        hb.beat("stream section done")
 
     # --- train-step throughput + MFU(train) -------------------------------
     try:

@@ -542,6 +542,40 @@ def audit_repo_entry_points(lower: bool = True) -> List[Finding]:
                        (str(e).splitlines() or ["?"])[0][:200])))
 
     try:
+        # the streaming programs (ISSUE 17): the in-jit per-tile delta
+        # summary (ops/delta.tile_delta_summary — one cast + one
+        # reduce_window over a uint8 frame pair, the (T,) f32 leaf
+        # serving/streams.py gates tiles on) dispatches once per frame
+        # on EVERY stream, so dynamic shapes, f64 leaks or retrace
+        # instability here would recompile on the streaming hot path;
+        # the tile predict the gated submits ride is the raw-uint8
+        # serve-bucket wire, pinned under its stream name so the
+        # surface stays audited even if the serve set changes
+        import numpy as np
+
+        from ..ops.delta import tile_delta_summary
+        g = 2
+        frame = np.zeros((g * _TINY["imsize"], g * _TINY["imsize"], 3),
+                         np.uint8)
+        findings += audit_entry(
+            lambda p, c: tile_delta_summary(p, c, grid=g),
+            (frame, frame), "stream_delta_summary[grid=%d]" % g,
+            lower=lower)
+        predict_st, variables_st, images_st = _tiny_serve_parts(2)
+        findings += audit_entry(
+            lambda v, im, _p=predict_st: _p(v, im),
+            (variables_st, images_st), "stream_tile_predict[b=2]",
+            lower=False)
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            rule="trace/trace-failure",
+            path="<stream_delta_summary>",
+            context="stream_delta_summary",
+            message="entry construction failed: %s: %s"
+                    % (type(e).__name__,
+                       (str(e).splitlines() or ["?"])[0][:200])))
+
+    try:
         # the quantized predict (--infer-dtype int8, ops/quant.py): the
         # BN fold + weight quantization run inside the program, so the
         # int8 entry has its own trace surface to keep honest — plus the

@@ -226,6 +226,25 @@ class Config:
     # tier pair the cascade spans; both must be named TIER_PRESETS tiers
     # with replica slots in the fleet
 
+    # streaming video (ISSUE 17: delta-gated tile inference,
+    # serving/streams.py + docs/ARCHITECTURE.md "Streaming video")
+    stream: bool = False          # route video through a StreamSession:
+    # per-tile change detection (ops.delta.tile_delta_summary) skips the
+    # backbone for static tiles; only changed tiles hit the serving plane
+    stream_threshold: Optional[float] = None  # a tile is CHANGED iff its
+    # mean |delta| >= threshold ([0, 255] scale). None = load the
+    # calibrated operating point from the newest committed
+    # artifacts/*/streams.json (`quality_matrix --streams`) via
+    # stream_overrides — the cascade promotion idiom; an explicit value
+    # wins (experiments off the calibrated point)
+    stream_tile_grid: int = 2     # frames split into grid x grid tiles,
+    # each the tile model's input size (fixed shapes under jit)
+    stream_ema: float = 0.5       # EMA weight of the PREVIOUS score when
+    # a recomputed tile's detection associates to a cached track
+    # (0 = no smoothing)
+    stream_track_radius: float = 8.0  # center-distance association
+    # radius (tile pixels) for the track stitching above
+
     # augmentation
     crop_percent: List[float] = field(default_factory=lambda: [0.0, 0.1])
     color_multiply: List[float] = field(default_factory=lambda: [1.2, 1.5])
@@ -524,6 +543,16 @@ class Config:
                 and not math.isfinite(self.cascade_threshold):
             raise ValueError("--cascade-threshold must be finite, got %r"
                              % (self.cascade_threshold,))
+        if self.stream_tile_grid < 1:
+            raise ValueError("--stream-tile-grid must be >= 1, got %d"
+                             % self.stream_tile_grid)
+        if self.stream_threshold is not None \
+                and not math.isfinite(self.stream_threshold):
+            raise ValueError("--stream-threshold must be finite, got %r"
+                             % (self.stream_threshold,))
+        if not 0.0 <= self.stream_ema < 1.0:
+            raise ValueError("--stream-ema must be in [0, 1), got %r"
+                             % (self.stream_ema,))
         if self.sentinel_spike < 0:
             raise ValueError("--sentinel-spike must be >= 0, got %r"
                              % (self.sentinel_spike,))
@@ -687,6 +716,58 @@ def apply_cascade(cfg: Config) -> Config:
     return dataclasses.replace(cfg, **over)
 
 
+def stream_overrides(repo_root: Optional[str] = None) -> dict:
+    """Calibrated tile-skip operating point from the newest committed
+    `quality_matrix --streams` artifact (same promotion idiom as
+    cascade_overrides: the committed artifact IS the record, highest
+    round wins).
+
+    Scans artifacts/*/streams.json for a `selected` record (threshold +
+    the skip-rate/blended-mAP evidence it was chosen on) and maps it
+    onto `stream_threshold`. Raises FileNotFoundError when no artifact
+    carries a selection — passing --stream-threshold explicitly
+    sidesteps the scan."""
+    import glob
+    import re
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    best = None
+    for path in glob.glob(os.path.join(root, "artifacts", "*",
+                                       "streams.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f).get("selected")
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not rec or "threshold" not in rec:
+            continue
+        m = re.search(r"r(\d+)",
+                      os.path.basename(os.path.dirname(path)))
+        key = int(m.group(1)) if m else -1
+        if best is None or key > best[0]:
+            best = (key, path, rec)
+    if best is None:
+        raise FileNotFoundError(
+            "--stream: no artifacts/*/streams.json carries a selected "
+            "operating point — run `quality_matrix --streams` first, or "
+            "pass --stream-threshold explicitly")
+    _, path, rec = best
+    return {"stream_threshold": float(rec["threshold"]),
+            "_source": os.path.relpath(path, root)}
+
+
+def apply_streams(cfg: Config) -> Config:
+    """Resolve `--stream` with no explicit threshold into the calibrated
+    operating point (no-op when streaming is off or a threshold was
+    passed)."""
+    if not cfg.stream or cfg.stream_threshold is not None:
+        return cfg
+    over = stream_overrides()
+    src = over.pop("_source")
+    print("--stream: %s -> %s" % (src, over), flush=True)
+    return dataclasses.replace(cfg, **over)
+
+
 def apply_preset(cfg: Config) -> Config:
     """Resolve `--preset` into concrete Config fields (no-op when unset)."""
     if not cfg.preset:
@@ -772,6 +853,7 @@ def get_config(argv=None) -> Config:
     cfg = apply_tier(cfg)
     cfg = apply_preset(cfg)
     cfg = apply_cascade(cfg)
+    cfg = apply_streams(cfg)
     seed_everything(cfg.random_seed)
 
     if cfg.platform:

@@ -1,5 +1,7 @@
 from .encode import encode_boxes, encode_boxes_batch, encode_boxes_jax, gaussian_radius
 from .decode import decode_heatmap, decode_peak_scores, peak_mask
+from .delta import (make_delta_fn, offset_detections, stitch_detections,
+                    tile_delta_summary, tile_origins, tile_shape)
 from .loss import (focal_loss, normed_l1_loss, detection_loss, LossLog,
                    split_stack_predictions, stacked_detection_loss)
 from .nms import maxpool_nms_mask, nms_mask, soft_nms_mask
@@ -32,4 +34,10 @@ __all__ = [
     "LossLog",
     "nms_mask",
     "soft_nms_mask",
+    "make_delta_fn",
+    "offset_detections",
+    "stitch_detections",
+    "tile_delta_summary",
+    "tile_origins",
+    "tile_shape",
 ]

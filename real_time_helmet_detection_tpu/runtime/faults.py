@@ -49,6 +49,15 @@ worker-death   returns the event — the CALLER         OOM-killed loader
                kills/fails its worker                 worker
 torn-write     returns the event — the CALLER         kill -9 mid-write
                truncates its write
+dropped-frame  returns the event — the CALLER         a camera/RTSP frame
+               (the stream session) answers from      lost on the wire
+               its cache + emits recover:frame-gap
+late-frame     returns the event — the CALLER marks   network jitter: the
+               the frame late (in-order delivery      frame shows up after
+               machinery absorbs it)                  its successor
+corrupt-frame  returns the event — the CALLER         truncated/garbled
+               quarantines the frame (never the       decode of one frame
+               delta reference) + answers from cache
 =============  =====================================  =====================
 
 `fire()`'s contract: raising kinds raise, delay kinds sleep, data kinds
@@ -67,9 +76,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from .errors import InjectedBackendError
 
-# raising kinds / delay kinds / caller-applied data kinds (see table)
+# raising kinds / delay kinds / caller-applied data kinds (see table);
+# the frame kinds (ISSUE 17) are data kinds the stream session applies
 FAULT_KINDS = ("device-loss", "hung-fetch", "slow-batch", "nan-batch",
-               "worker-death", "torn-write")
+               "worker-death", "torn-write", "dropped-frame",
+               "late-frame", "corrupt-frame")
 
 # the documented injection sites (callers may use others; these are the
 # instrumented ones and what seeded schedules draw from by default)
@@ -78,11 +89,15 @@ FLEET_SITES = ("fleet:dispatch", "fleet:replica")
 # the cascade escalation hop (ISSUE 16): its own tuple, NOT folded into
 # FLEET_SITES, so existing seeded fleet schedules replay bit-identically
 CASCADE_SITES = ("fleet:escalate",)
+# the stream session's frame-arrival site (ISSUE 17): its own tuple, NOT
+# folded into SERVE/FLEET_SITES, so existing seeded schedules replay
+# bit-identically
+STREAM_SITES = ("stream:frame",)
 TRAIN_SITES = ("train:batch", "train:rank")
 LOADER_SITES = ("loader:batch", "loader:worker")
 ARTIFACT_SITES = ("artifact:write",)
-ALL_SITES = (SERVE_SITES + FLEET_SITES + CASCADE_SITES + TRAIN_SITES
-             + LOADER_SITES + ARTIFACT_SITES)
+ALL_SITES = (SERVE_SITES + FLEET_SITES + CASCADE_SITES + STREAM_SITES
+             + TRAIN_SITES + LOADER_SITES + ARTIFACT_SITES)
 
 # which kinds make sense at which sites (seeded generation honors this;
 # parse() accepts anything — a hand-written schedule may be adversarial)
@@ -101,6 +116,12 @@ SITE_KINDS: Dict[str, Tuple[str, ...]] = {
     # router must degrade to the in-hand edge answer (`degraded_answer`),
     # never lose the ack
     "fleet:escalate": ("device-loss", "worker-death"),
+    # one stream frame's arrival (ISSUE 17): all three are data kinds —
+    # the session answers from its tile cache (dropped/corrupt, with a
+    # recover:frame-gap event; corrupt additionally quarantined from the
+    # delta reference) or absorbs the reorder (late); an acknowledged
+    # frame is never lost
+    "stream:frame": ("dropped-frame", "late-frame", "corrupt-frame"),
     "train:batch": ("nan-batch", "slow-batch"),
     # a data-parallel training RANK dies (ISSUE 11): the caller raises the
     # UNAVAILABLE signature so the surviving processes' job classifies
@@ -269,8 +290,11 @@ class ChaosInjector:
         if event is None:
             return None
         if self._tracer is not None:
-            self._tracer.event("fault:%s" % event.kind, site=site,
-                               at=event.at, seq=len(self.fired), **ctx)
+            # caller ctx wins on collision (a stream passes its own seq)
+            meta = {"site": site, "at": event.at,
+                    "arrival": len(self.fired)}
+            meta.update(ctx)
+            self._tracer.event("fault:%s" % event.kind, **meta)
         if event.kind == "device-loss":
             raise InjectedBackendError(
                 "UNAVAILABLE: injected device-loss at %s (arrival %d)"

@@ -6,8 +6,10 @@ jitted predict program that the reference never built: dynamic
 micro-batching into fixed-shape buckets, multiple in-flight batches,
 admission control (`engine.py`), and the multi-replica front door over N
 such engines — least-loaded dispatch, per-tenant budgets/SLOs, canary
-rollout, replica self-healing (`fleet.py`). See docs/ARCHITECTURE.md
-"Serving engine" and "Serving fleet".
+rollout, replica self-healing (`fleet.py`) — plus the per-stream
+delta-gated video front door over either (`streams.py`). See
+docs/ARCHITECTURE.md "Serving engine", "Serving fleet" and "Streaming
+video".
 """
 
 from .engine import (CLOSED, DEFAULT_BUCKETS, DEGRADED, DRAINING, SERVING,
@@ -15,11 +17,13 @@ from .engine import (CLOSED, DEFAULT_BUCKETS, DEGRADED, DRAINING, SERVING,
                      ServingEngine, SheddedError, resolve_buckets)
 from .fleet import (DEFAULT_TENANT, PROMOTED, ROLLED_BACK, FleetFuture,
                     FleetRouter, TenantSheddedError)
+from .streams import FrameResult, StreamFuture, StreamSession, smooth_tile
 
 __all__ = [
     "CLOSED", "DEFAULT_BUCKETS", "DEFAULT_TENANT", "DEGRADED", "DRAINING",
     "PROMOTED", "ROLLED_BACK", "SERVING", "EngineClosedError",
-    "FetchHungError", "FleetFuture", "FleetRouter", "ServeFuture",
-    "ServingEngine", "SheddedError", "TenantSheddedError",
-    "resolve_buckets",
+    "FetchHungError", "FleetFuture", "FleetRouter", "FrameResult",
+    "ServeFuture", "ServingEngine", "SheddedError", "StreamFuture",
+    "StreamSession", "TenantSheddedError", "resolve_buckets",
+    "smooth_tile",
 ]

@@ -790,6 +790,24 @@ def _selfcheck_trace(check) -> None:
                         "predict_cascade_summary[tier=edge]")
     check("cascade-summary predict audits clean", not cf)
 
+    # the streaming programs (ISSUE 17): the in-jit per-tile delta
+    # summary dispatches once per frame on every stream, and the tile
+    # predict the gated submits ride is the raw-uint8 serve wire —
+    # both must audit clean (baseline stays EMPTY); the delta program
+    # must also be retrace-stable, or every frame would recompile
+    from real_time_helmet_detection_tpu.ops.delta import (
+        tile_delta_summary)
+    frame_st = np.zeros((2 * 64, 2 * 64, 3), np.uint8)
+    df = ta.audit_entry(lambda p, c: tile_delta_summary(p, c, grid=2),
+                        (frame_st, frame_st),
+                        "stream_delta_summary[grid=2]")
+    check("stream delta-summary audits clean", not df)
+    predict_st, variables_st, images_st = ta._tiny_serve_parts(2)
+    stf = ta.audit_entry(lambda v, im, _p=predict_st: _p(v, im),
+                         (variables_st, images_st),
+                         "stream_tile_predict[b=2]", lower=False)
+    check("stream tile predict audits clean", not stf)
+
 
 def selfcheck(ast_only: bool = False) -> int:
     t0 = time.time()

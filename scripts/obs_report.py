@@ -24,14 +24,18 @@ ONE artifact:
   into per-request waterfalls — the **Traces** section carries the
   completeness verdict (orphans/broken chains are HARD errors), stage
   shares, the slowest requests' waterfalls and the fault/fleet events
-  joined into traces; per-request questions start HERE).
+  joined into traces; per-request questions start HERE),
+* stream delivery records          (ISSUE 17: `stream:frame` per-frame
+  records from serving/streams.py sessions — the **Streams** section
+  rolls up per-stream frames/computed-tile fraction/gap-and-late
+  accounting with delivery-latency digests, joined against the
+  `recover:frame-gap` cache-answer evidence).
 
 Output: `artifacts/<round>/obs/report.md` (human) + `report.json` and ONE
-JSON line on stdout (machine), schema `obs-report-v6` (v1–v5 reports —
+JSON line on stdout (machine), schema `obs-report-v7` (v1–v6 reports —
 earlier rounds — stay readable via `read_report`, which nulls the
-sections each lacks, incl. the v6 Fleet **Cascade** subsection:
-escalation rate, per-hop e2e split and degraded-answer accounting joined
-from `fleet:escalate`/`fleet:degraded`/`fleet:e2e` spans). Everything is read-only over its inputs (the queue
+sections each lacks, incl. the v6 Fleet **Cascade** subsection and the
+v7 **Streams** section). Everything is read-only over its inputs (the queue
 journal is parsed tolerantly, torn tails dropped, never repaired in
 place) and CPU-only — run it after any round, chip or not.
 
@@ -64,17 +68,19 @@ from real_time_helmet_detection_tpu.obs.spans import (  # noqa: E402
 from real_time_helmet_detection_tpu.utils import (  # noqa: E402
     atomic_write_bytes, save_json)
 
-SCHEMA = "obs-report-v6"
+SCHEMA = "obs-report-v7"
 READABLE_SCHEMAS = ("obs-report-v1", "obs-report-v2", "obs-report-v3",
-                    "obs-report-v4", "obs-report-v5", "obs-report-v6")
+                    "obs-report-v4", "obs-report-v5", "obs-report-v6",
+                    "obs-report-v7")
 # sections older schemas lack; read_report nulls them (v1 lacks every
 # group, v2 lacks Scaling + Fleet + Traces, v3 lacks Fleet + Traces,
-# v4 lacks Traces; v5 fleet sections lack the Cascade subsection,
-# nulled inside the fleet dict)
+# v4 lacks Traces, v6 and older lack Streams; v5 fleet sections lack
+# the Cascade subsection, nulled inside the fleet dict)
 V2_SECTIONS = ("metrics", "slo")
 V3_SECTIONS = ("scaling",)
 V4_SECTIONS = ("fleet",)
 V5_SECTIONS = ("traces",)
+V6_SECTIONS = ("streams",)
 
 
 def read_report(path: str) -> Optional[Dict]:
@@ -91,7 +97,8 @@ def read_report(path: str) -> Optional[Dict]:
     if rep.get("schema") not in READABLE_SCHEMAS:
         log("unreadable report schema %r in %s" % (rep.get("schema"), path))
         return None
-    for section in V2_SECTIONS + V3_SECTIONS + V4_SECTIONS + V5_SECTIONS:
+    for section in (V2_SECTIONS + V3_SECTIONS + V4_SECTIONS + V5_SECTIONS
+                    + V6_SECTIONS):
         rep.setdefault(section, None)
     if isinstance(rep.get("fleet"), dict):
         rep["fleet"].setdefault("cascade", None)  # pre-v6 fleet sections
@@ -520,6 +527,72 @@ def summarize_traces(paths: List[str], top_n: int = 5) -> Optional[Dict]:
     return summary
 
 
+def summarize_streams(paths: List[str]) -> Optional[Dict]:
+    """The Streams section (ISSUE 17): per-stream rollup of the
+    delta-gated video sessions' `stream:frame` delivery records (meta
+    sid/seq/computed/total/gap/late; dur_s is the resolve+stitch
+    delivery time) joined against the `recover:frame-gap` evidence of
+    dropped/corrupt frames answered from the tile cache. The aggregate
+    computed-tile fraction is the compute the gating actually spent —
+    the same quantity the serve-bench streams artifact gates. Returns
+    None when the round recorded no stream activity (every
+    pre-ISSUE-17 round)."""
+    per: Dict[str, Dict] = {}
+    gap_kinds: Dict[str, int] = {}
+    durs: Dict[str, List[float]] = {}
+    for path in paths:
+        for rec in read_spans(path):
+            name = rec.get("name", "")
+            meta = rec.get("meta") or {}
+            if name == "recover:frame-gap":
+                kind = str(meta.get("kind", "?"))
+                gap_kinds[kind] = gap_kinds.get(kind, 0) + 1
+                continue
+            if name != "stream:frame":
+                continue
+            sid = str(meta.get("sid", "?"))
+            st = per.setdefault(sid, {"frames": 0, "computed_tiles": 0,
+                                      "total_tiles": 0, "gaps": 0,
+                                      "late": 0})
+            st["frames"] += 1
+            if isinstance(meta.get("computed"), int):
+                st["computed_tiles"] += meta["computed"]
+            if isinstance(meta.get("total"), int):
+                st["total_tiles"] += meta["total"]
+            if meta.get("gap"):
+                st["gaps"] += 1
+            if meta.get("late"):
+                st["late"] += 1
+            dur = rec.get("dur_s")
+            if isinstance(dur, (int, float)):
+                durs.setdefault(sid, []).append(float(dur))
+    if not (per or gap_kinds):
+        return None
+
+    def digest(vals: List[float]) -> Dict:
+        s = sorted(vals)
+        return {"count": len(s),
+                "p50_ms": round(_pctl(s, 0.50) * 1e3, 3),
+                "p99_ms": round(_pctl(s, 0.99) * 1e3, 3),
+                "max_ms": round((s[-1] if s else float("nan")) * 1e3, 3)}
+
+    for sid, vals in durs.items():
+        per[sid]["delivery"] = digest(vals)
+    computed = sum(st["computed_tiles"] for st in per.values())
+    total = sum(st["total_tiles"] for st in per.values())
+    return {"streams": len(per),
+            "frames": sum(st["frames"] for st in per.values()),
+            "computed_tiles": computed, "total_tiles": total,
+            "computed_tile_fraction": (round(computed / total, 4)
+                                       if total else None),
+            "tile_skip_rate": (round(1.0 - computed / total, 4)
+                               if total else None),
+            "gaps": sum(st["gaps"] for st in per.values()),
+            "late": sum(st["late"] for st in per.values()),
+            "frame_gap_recoveries": dict(sorted(gap_kinds.items())),
+            "per_stream": {sid: per[sid] for sid in sorted(per)}}
+
+
 def summarize_queue(queue_dir: Optional[str]) -> Optional[Dict]:
     """Read-only tolerant replay of the job journal: per-job final state,
     attempts, salvage evidence, queued->terminal wall seconds."""
@@ -637,6 +710,7 @@ def build_report(round_name: str, span_paths: List[str],
         "slo": summarize_slo(span_paths),
         "scaling": summarize_scaling(scaling_paths or [], span_paths),
         "fleet": summarize_fleet(span_paths),
+        "streams": summarize_streams(span_paths),
         "traces": summarize_traces(span_paths),
         "queue": summarize_queue(queue_dir),
         "bench": summarize_bench(bench_paths),
@@ -869,6 +943,37 @@ def render_markdown(rep: Dict) -> str:
                                 ev["what"], ev["name"]))
     else:
         lines.append("_no fleet activity recorded_")
+    lines += [""]
+    stm = rep.get("streams")
+    lines += ["## Streams", ""]
+    if stm:
+        frac = stm.get("computed_tile_fraction")
+        lines += ["%d stream(s), %d frame(s) delivered: %d/%d tiles "
+                  "computed (%s), %d gap frame(s), %d late"
+                  % (stm["streams"], stm["frames"], stm["computed_tiles"],
+                     stm["total_tiles"],
+                     ("computed fraction %.1f%%" % (100 * frac)
+                      if isinstance(frac, (int, float))
+                      else "fraction ?"),
+                     stm["gaps"], stm["late"]), ""]
+        if stm["frame_gap_recoveries"]:
+            lines += ["Frame-gap recoveries (cache answers): " + ", ".join(
+                "%s ×%d" % (k, v)
+                for k, v in stm["frame_gap_recoveries"].items()), ""]
+        rows = [(sid, st) for sid, st in stm["per_stream"].items()]
+        if rows:
+            lines += ["| sid | frames | computed | total | gaps | late "
+                      "| delivery p50 ms | p99 ms |", "|---|---|---|---|"
+                      "---|---|---|---|"]
+            for sid, st in rows:
+                d = st.get("delivery") or {}
+                lines.append("| %s | %d | %d | %d | %d | %d | %s | %s |"
+                             % (sid, st["frames"], st["computed_tiles"],
+                                st["total_tiles"], st["gaps"], st["late"],
+                                d.get("p50_ms", "?"), d.get("p99_ms", "?")))
+            lines += [""]
+    else:
+        lines.append("_no stream activity recorded_")
     lines += [""]
     trc = rep.get("traces")
     lines += ["## Traces", ""]
@@ -1127,6 +1232,20 @@ def selfcheck() -> int:
                      reason="escalate-fault:InjectedBackendError")
         tracer.record("fleet:e2e", 0.009, rid=0, escalated=True,
                       degraded=True)
+        # streaming taxonomy (ISSUE 17, obs-report-v7): per-frame
+        # delivery records for two delta-gated sessions (sid 0 takes a
+        # dropped-frame gap answered from the tile cache, sid 1 a late
+        # frame) — the Streams section's joins
+        tracer.record("stream:frame", 0.004, sid=0, seq=0, computed=4,
+                      total=4, gap=False, late=False)
+        tracer.record("stream:frame", 0.002, sid=0, seq=1, computed=1,
+                      total=4, gap=False, late=False)
+        tracer.record("stream:frame", 0.001, sid=0, seq=2, computed=0,
+                      total=4, gap=True, late=False)
+        tracer.record("stream:frame", 0.003, sid=1, seq=0, computed=4,
+                      total=4, gap=False, late=True)
+        tracer.event("recover:frame-gap", sid=0, seq=2,
+                     kind="dropped-frame")
         tracer.close()
         with open(span_path, "a") as f:  # graftlint: off=raw-artifact-write
             f.write('{"kind": "span", "torn')  # kill -9 mid-append twin
@@ -1235,11 +1354,12 @@ def selfcheck() -> int:
         check("schema tagged", rep["schema"] == SCHEMA)
         sp = rep["spans"]
         check("torn span tail dropped, all real records read",
-              sp["records"] == 67)  # meta + 4 steps + ckpt + hb + ctx
+              sp["records"] == 72)  # meta + 4 steps + ckpt + hb + ctx
         # + 16 serve spans + shed event + 7 fault/recover events +
         # reload span + 2 alert events + 4 scale spans + 10 fleet events
-        # + 10 trace-fixture records + 6 cascade records + log2's meta +
-        # rank-1 step (both torn tails dropped)
+        # + 10 trace-fixture records + 6 cascade records + 4 stream
+        # records + frame-gap event + log2's meta + rank-1 step (both
+        # torn tails dropped)
         check("step span stats", sp["by_name"].get("step", {}).get(
             "count") == 5 and abs(sp["by_name"]["step"]["total_s"]
                                   - 0.11) < 1e-6)
@@ -1360,6 +1480,22 @@ def selfcheck() -> int:
                       trc["waterfalls"][0]["waterfall"])
               and trc["events_in_traces"].get("fault:device-loss") == 1
               and trc["events_in_traces"].get("fleet:redispatch") == 1)
+        stm = rep["streams"]
+        check("streams section joined", stm is not None
+              and stm["streams"] == 2 and stm["frames"] == 4
+              and stm["computed_tiles"] == 9 and stm["total_tiles"] == 16
+              and stm["computed_tile_fraction"] == 0.5625
+              and stm["tile_skip_rate"] == 0.4375
+              and stm["gaps"] == 1 and stm["late"] == 1
+              and stm["frame_gap_recoveries"] == {"dropped-frame": 1})
+        check("streams per-stream rollup + delivery digest",
+              stm["per_stream"]["0"]["frames"] == 3
+              and stm["per_stream"]["0"]["computed_tiles"] == 5
+              and stm["per_stream"]["0"]["gaps"] == 1
+              and stm["per_stream"]["0"]["delivery"]["p50_ms"] == 2.0
+              and stm["per_stream"]["1"]["late"] == 1)
+        check("stream frame-gap recovery also joins the faults section",
+              flt["recoveries"].get("frame-gap") == 1)
         q = rep["queue"]
         check("queue states joined", q is not None
               and q["jobs"]["bench"]["state"] == "done"
@@ -1404,6 +1540,11 @@ def selfcheck() -> int:
               "### Cascade" in md and "2 escalated (rate 66.7%)" in md
               and "1 degraded answer(s)" in md
               and "escalate-fault:InjectedBackendError" in md)
+        check("markdown carries streams section",
+              "## Streams" in md
+              and "9/16 tiles computed" in md
+              and "dropped-frame ×1" in md
+              and "| 0 | 3 | 5 | 12 | 1 | 0 |" in md)
 
         # schema compat: the generated v2 report reads back through
         # read_report, and a committed v1 report (a pre-ISSUE-10 round)
@@ -1481,6 +1622,24 @@ def selfcheck() -> int:
               and v5["spans"]["records"] == 11)
         check("v1-v4 fleet sections also null cascade on read",
               v4["fleet"]["cascade"] is None)
+        # a committed v6 report (pre-ISSUE-17 round) nulls only Streams
+        v6_path = os.path.join(tmp, "report_v6.json")
+        atomic_write_bytes(v6_path, json.dumps(
+            {"schema": "obs-report-v6", "round": "r16",
+             "metrics": {"files": []}, "slo": None,
+             "scaling": {"files": [], "spans": {}},
+             "fleet": {"dispatches_total": 3, "cascade": {"requests": 3}},
+             "traces": {"traces": 0},
+             "spans": {"records": 13}}).encode())
+        v6 = read_report(v6_path)
+        check("v6 report readable with streams nulled",
+              v6 is not None and v6["streams"] is None
+              and v6["fleet"]["cascade"] is not None
+              and v6["traces"] is not None
+              and v6["spans"]["records"] == 13)
+        check("v1-v5 reports also null streams on read",
+              v1["streams"] is None and v3["streams"] is None
+              and v5["streams"] is None)
         junk_path = os.path.join(tmp, "report_junk.json")
         atomic_write_bytes(junk_path, json.dumps(
             {"schema": "obs-report-v9"}).encode())

@@ -349,6 +349,76 @@ def obs_from_cascade_calibration(d: Dict, rnd: int, source: str) -> \
     return out
 
 
+def obs_from_streams_bench(d: Dict, rnd: int, source: str) -> List[Obs]:
+    """serve-bench-streams-v1 rows (ISSUE 17): the delta-gated vs
+    full-inference goodput ratio gates in the tight `eff` class — both
+    arms run on the same box at the same time over the same seeded
+    frame trace, so box noise cancels like every same-box ratio; the
+    computed-tile fraction (the compute the gating actually spent,
+    LOWER is better) rides next to it, and the per-mode goodput and
+    p99 rows gate in the wide rate/time classes."""
+    if d.get("schema") != "serve-bench-streams-v1":
+        return []
+    platform = d.get("platform") or "?"
+    sig = "%s,%s,g%s,simt%g,x%g" % (
+        platform, d.get("imsize", "?"), d.get("tile_grid", "?"),
+        d.get("tile_sim_ms", 0), d.get("stream_load", 0))
+    out = []
+    if isinstance(d.get("stream_goodput_ratio"), (int, float)):
+        out.append(Obs("stream[%s].goodput_ratio" % sig,
+                       d["stream_goodput_ratio"], HIGHER, "eff",
+                       platform, rnd, source))
+    if isinstance(d.get("computed_tile_fraction"), (int, float)):
+        out.append(Obs("stream[%s].computed_tile_fraction" % sig,
+                       d["computed_tile_fraction"], LOWER, "eff",
+                       platform, rnd, source))
+    for row in d.get("rows") or []:
+        mode = row.get("mode")
+        if not mode:
+            continue
+        if isinstance(row.get("goodput_fps"), (int, float)):
+            out.append(Obs("stream[%s].goodput@%s" % (sig, mode),
+                           row["goodput_fps"], HIGHER, "rate", platform,
+                           rnd, source))
+        if isinstance(row.get("p99_ms"), (int, float)):
+            out.append(Obs("stream[%s].p99_ms@%s" % (sig, mode),
+                           row["p99_ms"], LOWER, "time", platform, rnd,
+                           source))
+    return out
+
+
+def obs_from_streams_calibration(d: Dict, rnd: int, source: str) -> \
+        List[Obs]:
+    """stream-calibration-v1 (ISSUE 17): the selected skip threshold's
+    blended video mAP and its delta vs full inference gate in the
+    ABSOLUTE `quality` class (a blended video answer drifting >2 pts
+    below full inference fails on any platform) next to the full-video
+    anchor; the selected tile skip rate gates HIGHER in `eff` — a
+    recalibration that buys less skipping at the same fixture is a
+    regression. Keyed on the fixture so a smoke calibration never
+    gates a chip-scale one."""
+    if d.get("schema") != "stream-calibration-v1":
+        return []
+    platform = d.get("platform") or "?"
+    fix = d.get("fixture") or {}
+    sig = "%s,%s,%s%s" % (platform, fix.get("imsize", "?"),
+                          fix.get("style", "?"),
+                          ",smoke" if d.get("smoke") else "")
+    out = []
+    sel = d.get("selected") or {}
+    for key, val in (("blended_video_map", sel.get("blended_video_mAP")),
+                     ("delta_vs_full", sel.get("delta_vs_full")),
+                     ("full_video_map", d.get("full_video_mAP"))):
+        if isinstance(val, (int, float)):
+            out.append(Obs("streamcal[%s].%s" % (sig, key), val, HIGHER,
+                           "quality", platform, rnd, source))
+    if isinstance(sel.get("tile_skip_rate"), (int, float)):
+        out.append(Obs("streamcal[%s].tile_skip_rate" % sig,
+                       sel["tile_skip_rate"], HIGHER, "eff", platform,
+                       rnd, source))
+    return out
+
+
 def obs_from_roofline(d: Dict, rnd: int, source: str) -> List[Obs]:
     if d.get("schema") != "roofline-v1":
         return []  # roofline-diff-v1 etc. are derived artifacts
@@ -513,6 +583,7 @@ def scan_observations(root: str) -> List[Obs]:
         out += obs_from_serve_artifact(d, _round_of(path), rel(path))
         out += obs_from_fleet_artifact(d, _round_of(path), rel(path))
         out += obs_from_cascade_bench(d, _round_of(path), rel(path))
+        out += obs_from_streams_bench(d, _round_of(path), rel(path))
     for path in sorted(glob.glob(os.path.join(
             root, "artifacts", "*", "roofline", "*.json"))):
         try:
@@ -545,6 +616,14 @@ def scan_observations(root: str) -> List[Obs]:
         except (OSError, json.JSONDecodeError):
             continue
         out += obs_from_cascade_calibration(d, _round_of(path), rel(path))
+    for path in sorted(glob.glob(os.path.join(
+            root, "artifacts", "*", "streams.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        out += obs_from_streams_calibration(d, _round_of(path), rel(path))
     for path in sorted(glob.glob(os.path.join(
             root, "artifacts", "*", "obs", "metrics*.jsonl"))):
         out += obs_from_metrics_jsonl(path, _round_of(path), rel(path))
@@ -686,6 +765,10 @@ def candidate_observations(path: str) -> List[Obs]:
         return obs_from_cascade_bench(d, rnd, path)
     if d.get("schema") == "cascade-calibration-v1":
         return obs_from_cascade_calibration(d, rnd, path)
+    if d.get("schema") == "serve-bench-streams-v1":
+        return obs_from_streams_bench(d, rnd, path)
+    if d.get("schema") == "stream-calibration-v1":
+        return obs_from_streams_calibration(d, rnd, path)
     if d.get("schema") == "roofline-v1":
         return obs_from_roofline(d, rnd, path)
     if d.get("schema") == "scaling-v2":
@@ -847,6 +930,14 @@ def _fixture_tree(tmp: str) -> None:
           _cascade_bench_fixture(2.6, 1900.0))
     jline(os.path.join(tmp, "artifacts", "r02", "cascade.json"),
           _cascade_calib_fixture(0.78))
+    # serve-bench-streams-v1 + stream-calibration-v1 (ISSUE 17): the
+    # delta-gated streaming acceptance fixtures — a -20% goodput-ratio
+    # regression and a -3 pt blended-video-mAP drift must both FAIL
+    jline(os.path.join(tmp, "artifacts", "r02", "serving",
+                       "serve_bench_streams.json"),
+          _streams_bench_fixture(22.0, 107.0))
+    jline(os.path.join(tmp, "artifacts", "r02", "streams.json"),
+          _streams_calib_fixture(0.78))
 
 
 def _quality_fixture(edge_map: float) -> Dict:
@@ -893,6 +984,34 @@ def _cascade_calib_fixture(blended_map: float) -> Dict:
                          "blended_mAP": blended_map,
                          "delta_vs_all_quality":
                              round(blended_map - 0.80, 4)}}
+
+
+def _streams_bench_fixture(ratio: float, gated_goodput: float) -> Dict:
+    return {"schema": "serve-bench-streams-v1", "platform": "cpu",
+            "imsize": 64, "tile_grid": 2, "tiles": 4, "streams": 4,
+            "redundancy": 0.75, "tile_sim_ms": 10.0, "stream_load": 2.5,
+            "computed_tile_fraction": 0.27, "tile_skip_rate": 0.73,
+            "stream_goodput_ratio": ratio,
+            "rows": [
+                {"mode": "delta-gated", "goodput_fps": gated_goodput,
+                 "p99_ms": 420.0, "lost": 0},
+                {"mode": "full-inference",
+                 "goodput_fps": round(gated_goodput / ratio, 2),
+                 "p99_ms": 770.0, "lost": 0}],
+            "gate_streams_2x": True, "gate_zero_lost_acks": True}
+
+
+def _streams_calib_fixture(blended_map: float) -> Dict:
+    return {"schema": "stream-calibration-v1", "platform": "cpu",
+            "smoke": True,
+            "fixture": {"style": "blocks", "imsize": 64, "tile_grid": 2,
+                        "sequences": 8, "frames": 8, "redundancy": 0.75},
+            "full_video_mAP": 0.79,
+            "sweep": [],
+            "selected": {"threshold": 25.65, "tile_skip_rate": 0.66,
+                         "blended_video_mAP": blended_map,
+                         "delta_vs_full":
+                             round(blended_map - 0.79, 4)}}
 
 
 def _fleet_fixture(eff4: float, goodput4: float) -> Dict:
@@ -1098,6 +1217,41 @@ def selfcheck() -> int:
         check("-1 pt blended mAP wiggle passes",
               run(["--root", tmp, "--ledger", ledger,
                    "--candidate", ok_cc]) == 0)
+        # the ISSUE 17 acceptance fixtures: the stream goodput ratio is
+        # a same-box same-trace ratio in the tight `eff` class (both
+        # arms replay one seeded frame trace at the same offered load),
+        # and the blended VIDEO mAP gates ABSOLUTE like every quality
+        # metric
+        check("stream goodput ratio tracked in the ledger",
+              "stream[cpu,64,g2,simt10,x2.5].goodput_ratio"
+              in load_ledger(ledger)["entries"])
+        check("stream computed-tile fraction tracked in the ledger",
+              "stream[cpu,64,g2,simt10,x2.5].computed_tile_fraction"
+              in load_ledger(ledger)["entries"])
+        check("stream blended video mAP tracked in the ledger",
+              "streamcal[cpu,64,blocks,smoke].blended_video_map"
+              in load_ledger(ledger)["entries"])
+        bad_st = os.path.join(tmp, "cand_streams.json")
+        save_json(bad_st,
+                  _streams_bench_fixture(round(22.0 * 0.8, 4), 107.0))
+        check("-20% stream goodput ratio FAILS the gate",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", bad_st]) == 1)
+        ok_st = os.path.join(tmp, "cand_streams_ok.json")
+        save_json(ok_st, _streams_bench_fixture(20.5, 90.0))
+        check("stream ratio wiggle + cpu goodput dip pass",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", ok_st]) == 0)
+        bad_sc = os.path.join(tmp, "cand_stream_calib.json")
+        save_json(bad_sc, _streams_calib_fixture(round(0.78 - 0.03, 4)))
+        check("-3 pt blended video mAP FAILS the gate",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", bad_sc]) == 1)
+        ok_sc = os.path.join(tmp, "cand_stream_calib_ok.json")
+        save_json(ok_sc, _streams_calib_fixture(round(0.78 - 0.01, 4)))
+        check("-1 pt blended video mAP wiggle passes",
+              run(["--root", tmp, "--ledger", ledger,
+                   "--candidate", ok_sc]) == 0)
         # within-tolerance chip wiggle and a 30%-slow CPU line both pass
         okc = os.path.join(tmp, "cand_ok.json")
         save_json(okc, {"platform": "tpu", "imsize": 512, "batch": 16,

@@ -64,8 +64,23 @@ cascade-calibration-v1), which `config.cascade_overrides` loads for
 `--cascade` serving exactly the way quant scales artifacts are loaded,
 and perfgate gates in its ABSOLUTE `quality` class.
 
+`--streams` (ISSUE 17) calibrates the temporal tile-skip threshold on a
+VIDEO fixture synthesized from the same held-out split (tiles drawn
+from the pool, per-tile replacement with prob 1-redundancy per frame,
+plus a small uint8 sensor jitter so static tiles carry a nonzero delta
+floor): every noisy tile is scored once by the quality tier and every
+consecutive-frame `ops.delta.tile_delta_summary` leaf is fetched once,
+then each candidate threshold replays the stream-session cache OFFLINE
+(a tile recomputes iff its delta >= t, else its last computed answer
+stands) into a tile-skip-rate vs blended-video-mAP curve. The chosen
+operating point — the LARGEST skip rate whose blended video mAP is
+within 2 pts of full inference — lands in
+`artifacts/<round>/streams.json` (schema stream-calibration-v1), which
+`config.stream_overrides` resolves for `--stream` serving, and perfgate
+gates in its ABSOLUTE `quality` class.
+
 Usage: python scripts/quality_matrix.py [--epochs N] [--train N] [--test N]
-       [--only row[,row]] [--smoke] [--tiers] [--cascade]
+       [--only row[,row]] [--smoke] [--tiers] [--cascade] [--streams]
 """
 
 from __future__ import annotations
@@ -686,11 +701,322 @@ def run_cascade(smoke: bool) -> None:
                       "out": out_path}))
 
 
+def run_streams(smoke: bool) -> None:
+    """`--streams` (ISSUE 17): tile-skip-threshold calibration — see
+    module docstring. Shares the tier fixture AND the quality tier's
+    training with `--tiers`/`--cascade` (reused via its DONE marker);
+    the video fixture is synthesized from the held-out split."""
+    if smoke:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import numpy as np
+
+    from real_time_helmet_detection_tpu.config import (Config,
+                                                       TIER_PRESETS,
+                                                       save_config)
+    from real_time_helmet_detection_tpu.data import (BatchLoader,
+                                                     load_dataset,
+                                                     make_synthetic_voc)
+    from real_time_helmet_detection_tpu.data.voc import boxes_from_voc_dict
+    from real_time_helmet_detection_tpu.evaluate import (_origin_size,
+                                                         load_eval_state)
+    from real_time_helmet_detection_tpu.metrics import compute_map
+    from real_time_helmet_detection_tpu.ops.delta import (make_delta_fn,
+                                                          tile_origins)
+    from real_time_helmet_detection_tpu.predict import make_predict_fn
+    from real_time_helmet_detection_tpu.train import train
+
+    epochs = arg("--epochs", 45)
+    n_train = arg("--train", 128 if smoke else 640)
+    n_test = arg("--test", 32 if smoke else 96)
+    imsize = 64 if smoke else 512
+    batch = 4 if smoke else 16
+    style = "blocks" if smoke else "scenes"  # run_cascade's fixture note
+    max_objects = 4 if smoke else 12
+    wscale = 4 if smoke else 1
+    # the video fixture: grid x grid tiles drawn from the held-out pool,
+    # per-tile replacement with prob (1 - redundancy) per frame, plus a
+    # +/-`noise` uint8 sensor jitter so STATIC tiles still carry a
+    # nonzero delta floor — gating has a real operating curve, not a
+    # trivial ==0 split
+    grid = 2
+    T = arg("--frames", 8 if smoke else 16)
+    n_seq = arg("--seqs", 8 if smoke else 16)
+    redundancy = 0.75
+    noise = 2
+    archs = {
+        name: {"variant": p["variant"], "num_stack": p["num_stack"],
+               "width": max(8, p["hourglass_inch"] // wscale)}
+        for name, p in TIER_PRESETS.items()}
+    data_root = "/tmp/voc_%s_tiers_%d" % (style, imsize)
+    work_root = "/tmp/qmatrix_tiers" + ("_smoke" if smoke else "")
+
+    ds_meta = {"n_train": n_train, "n_test": n_test, "imsize": imsize,
+               "style": style, "max_objects": max_objects}
+    meta_path = os.path.join(data_root, "dataset_meta.json")
+    have = None
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                have = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            have = None
+    if have != ds_meta:
+        if os.path.isdir(data_root):
+            import shutil
+            shutil.rmtree(data_root)
+        log("generating %s dataset (%d train / %d test @%d^2)..."
+            % (style, n_train, n_test, imsize))
+        make_synthetic_voc(data_root, num_train=n_train, num_test=n_test,
+                           imsize=(imsize, imsize),
+                           max_objects=max_objects, seed=42, style=style)
+        save_json(meta_path, ds_meta)
+
+    hb = maybe_job_heartbeat()
+
+    # quality tier only — the stream serves whatever tier the tenant
+    # routes to, but the CALIBRATION scores the flagship recipe (the
+    # skip threshold is about frame dynamics, not model capacity)
+    a = archs["quality"]
+    qsave = os.path.join(work_root, "quality")
+    marker = os.path.join(qsave, "TRAIN_DONE")
+    if os.path.exists(marker):
+        log("training %s already complete (marker)" % qsave)
+    else:
+        if os.path.isdir(qsave) and os.listdir(qsave):
+            log("partial training at %s; clearing and retraining" % qsave)
+            import shutil
+            shutil.rmtree(qsave)
+        os.makedirs(qsave, exist_ok=True)
+        cfg = Config(
+            train_flag=True, data=data_root, save_path=qsave,
+            variant=a["variant"], num_stack=a["num_stack"],
+            hourglass_inch=a["width"], stem_width=min(128, a["width"]),
+            num_cls=2, batch_size=batch,
+            amp=True, optim="adam", lr=5e-4,
+            lr_milestone=[int(epochs * 0.5), int(epochs * 0.9)],
+            end_epoch=epochs, device_augment=True, cache_device=True,
+            multiscale_flag=False, multiscale=[imsize, imsize, 64],
+            keep_ckpt=2, ckpt_interval=max(1, epochs // 2),
+            hang_warn_seconds=1200, num_workers=4, print_interval=10,
+            summary=False)
+        from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
+        with maybe_tracer().span("train-streams-tier", save=qsave) as sp:
+            train(cfg)
+        save_config(cfg, qsave)
+        atomic_write_bytes(marker, ("wall_s=%.1f\n" % sp.dur_s).encode())
+        log("training %s done in %.0fs" % (qsave, sp.dur_s))
+        hb.beat("trained quality tier")
+
+    cks = [d for d in os.listdir(qsave) if d.startswith("check_point_")]
+    if not cks:
+        raise RuntimeError("no checkpoint under %s" % qsave)
+    ckpt = os.path.join(qsave, max(
+        cks, key=lambda d: int(d.rsplit("_", 1)[1])))
+    qcfg = Config(train_flag=False, data=data_root, save_path=qsave,
+                  model_load=ckpt, variant=a["variant"],
+                  num_stack=a["num_stack"], hourglass_inch=a["width"],
+                  stem_width=min(128, a["width"]), num_cls=2,
+                  batch_size=batch, imsize=imsize, topk=100,
+                  conf_th=0.01, nms="nms", nms_th=0.5, num_workers=2)
+    qmodel, qvars = load_eval_state(qcfg)
+    predict = make_predict_fn(qmodel, qcfg, normalize=qcfg.pretrained)
+
+    # the held-out split is the tile pool
+    dataset, augmentor = load_dataset(qcfg)
+    loader = BatchLoader(dataset, augmentor, batch_size=batch,
+                         pretrained=qcfg.pretrained, num_cls=2,
+                         normalized_coord=qcfg.normalized_coord,
+                         scale_factor=qcfg.scale_factor,
+                         max_boxes=qcfg.max_boxes, shuffle=False,
+                         drop_last=False, num_workers=2, raw=True)
+    images, infos = [], []
+    for b in loader:
+        for j in range(len(b.infos)):
+            images.append(np.asarray(b.image[j]))
+            infos.append(b.infos[j])
+    if hasattr(loader, "close"):
+        loader.close()
+    n_pool = len(images)
+    tiles_per = grid * grid
+    log("synthesizing %d streams x %d frames from %d held-out tiles"
+        % (n_seq, T, n_pool))
+
+    # seeded sequence content: seqs[s][f][k] = pool index of tile k
+    rng = np.random.default_rng(1717)
+    seq_idx = []
+    for s in range(n_seq):
+        cur = [int(i) for i in rng.integers(0, n_pool, size=tiles_per)]
+        fr = [list(cur)]
+        for f in range(1, T):
+            cur = [int(rng.integers(0, n_pool))
+                   if rng.random() >= redundancy else i for i in cur]
+            fr.append(list(cur))
+        seq_idx.append(fr)
+    # per-(s,f,k) noisy tile (the noise draw is part of the fixture —
+    # identical across candidate thresholds)
+    noisy = {}
+    for s in range(n_seq):
+        for f in range(T):
+            for k in range(tiles_per):
+                img = images[seq_idx[s][f][k]].astype(np.int16)
+                jit = rng.integers(-noise, noise + 1, size=img.shape)
+                noisy[(s, f, k)] = np.clip(
+                    img + jit, 0, 255).astype(np.uint8)
+
+    # dispatch EVERY noisy-tile b1 predict, ONE batched fetch (the
+    # fetch discipline run_cascade's collect() uses)
+    keys = sorted(noisy)
+    pend = [predict(qvars, noisy[k][None]) for k in keys]
+    preds = {k: type(d)(*(np.asarray(leaf[0]) for leaf in d))
+             for k, d in zip(keys, jax.device_get(pend))}
+    hb.beat("tile predictions scored")
+
+    # every consecutive-frame delta summary — the EXACT in-jit program
+    # the stream session runs (ops/delta.py), dispatched-all fetched-once
+    fshape = (grid * imsize, grid * imsize, 3)
+    origins = tile_origins(fshape, grid)
+    delta_fn = make_delta_fn(grid)
+
+    def assemble(s, f):
+        ts = [noisy[(s, f, k)] for k in range(tiles_per)]
+        rows = [np.concatenate(ts[r * grid:(r + 1) * grid], axis=1)
+                for r in range(grid)]
+        return np.concatenate(rows, axis=0)
+
+    frames = {(s, f): assemble(s, f)
+              for s in range(n_seq) for f in range(T)}
+    dkeys = [(s, f) for s in range(n_seq) for f in range(1, T)]
+    dpend = [delta_fn(frames[(s, f - 1)], frames[(s, f)])
+             for s, f in dkeys]
+    deltas = {k: np.asarray(v)
+              for k, v in zip(dkeys, jax.device_get(dpend))}
+    hb.beat("delta summaries scored")
+
+    # frame-level ground truth in MODEL coordinates: each tile's VOC
+    # boxes scaled to the model canvas, offset to its tile origin
+    gt_boxes, gt_labels = {}, {}
+    tile_gt = {}
+    for idx in {i for fr in seq_idx for tl in fr for i in tl}:
+        ow, oh = _origin_size(infos[idx])
+        gb, gl = boxes_from_voc_dict(infos[idx])
+        sc = np.array([imsize / ow, imsize / oh,
+                       imsize / ow, imsize / oh], np.float32)
+        tile_gt[idx] = (gb * sc, gl)
+    for s in range(n_seq):
+        for f in range(T):
+            fid = "s%02d_f%02d" % (s, f)
+            bs, ls = [], []
+            for k in range(tiles_per):
+                y0, x0 = origins[k]
+                gb, gl = tile_gt[seq_idx[s][f][k]]
+                bs.append(gb + np.array([x0, y0, x0, y0], np.float32))
+                ls.append(gl)
+            gt_boxes[fid] = (np.concatenate(bs) if bs
+                             else np.zeros((0, 4), np.float32))
+            gt_labels[fid] = (np.concatenate(ls) if ls
+                              else np.zeros((0,), np.int64))
+
+    def blended(t):
+        """Offline replay of the session cache at threshold `t`:
+        (blended video mAP, tile_skip_rate). A tile computes iff first
+        frame or its delta >= t (streams.py's `changed` rule); a
+        skipped tile answers with its LAST COMPUTED detections."""
+        computed, total = 0, 0
+        db, dc, dsc = {}, {}, {}
+        for s in range(n_seq):
+            cache = [None] * tiles_per
+            for f in range(T):
+                fid = "s%02d_f%02d" % (s, f)
+                bs, cs, ss = [], [], []
+                for k in range(tiles_per):
+                    total += 1
+                    if (f == 0 or cache[k] is None
+                            or float(deltas[(s, f)][k]) >= t):
+                        cache[k] = preds[(s, f, k)]
+                        computed += 1
+                    row = cache[k]
+                    keep = row.valid
+                    y0, x0 = origins[k]
+                    bs.append(row.boxes[keep]
+                              + np.array([x0, y0, x0, y0], np.float32))
+                    cs.append(row.classes[keep])
+                    ss.append(row.scores[keep])
+                db[fid] = (np.concatenate(bs) if bs
+                           else np.zeros((0, 4), np.float32))
+                dc[fid] = np.concatenate(cs)
+                dsc[fid] = np.concatenate(ss)
+        m = compute_map(gt_boxes, gt_labels, db, dc, dsc, num_cls=2)
+        return (round(float(m["map"]), 4),
+                round(1.0 - computed / total, 4))
+
+    full_map, _ = blended(0.0)  # t=0: every tile computes (delta >= 0)
+    dvals = np.concatenate([deltas[k] for k in dkeys])
+    log("full-inference video mAP %.4f, delta range [%.2f, %.2f]"
+        % (full_map, float(dvals.min()), float(dvals.max())))
+
+    # the sweep: one candidate per distinct observed delta (the curve's
+    # only knees) plus 0.0 (= full inference), thinned to ~33 quantile
+    # points exactly like run_cascade's confidence sweep
+    cand = sorted(set([0.0] + [round(float(v), 4) for v in dvals]))
+    if len(cand) > 33:
+        idx = np.linspace(0, len(cand) - 1, 33).round().astype(int)
+        cand = [cand[i] for i in sorted(set(idx.tolist()))]
+    sweep = []
+    for t in cand:
+        m, skip = blended(t)
+        row = {"threshold": round(float(t), 6), "tile_skip_rate": skip,
+               "blended_video_mAP": m,
+               "delta_vs_full": round(m - full_map, 4)}
+        sweep.append(row)
+        log("t=%.4f: skip %.0f%%, blended video mAP %.4f (%+.4f vs "
+            "full)" % (t, 100 * skip, m, row["delta_vs_full"]))
+    hb.beat("threshold sweep done")
+
+    # operating point: LARGEST tile-skip rate within 2 pts of full
+    # inference (always satisfiable: t=0 IS full inference)
+    ok_rows = [r for r in sweep if r["delta_vs_full"] >= -0.02]
+    selected = dict(max(ok_rows, key=lambda r: r["tile_skip_rate"]))
+    selected["rule"] = ("max tile_skip_rate with blended video mAP >= "
+                        "full - 0.02")
+
+    out_path = os.path.join(os.path.dirname(OUT_PATH), "streams.json")
+    out = {"schema": "stream-calibration-v1",
+           "platform": jax.default_backend(), "smoke": smoke,
+           "fixture": {"style": style, "imsize": imsize,
+                       "n_train": n_train, "n_test": n_test,
+                       "epochs": epochs, "width_scale": wscale,
+                       "tile_grid": grid, "frames": T,
+                       "sequences": n_seq, "redundancy": redundancy,
+                       "noise": noise},
+           "arch": dict(a),
+           "full_video_mAP": full_map,
+           "delta": {"min": round(float(dvals.min()), 4),
+                     "median": round(float(np.median(dvals)), 4),
+                     "max": round(float(dvals.max()), 4)},
+           "sweep": sweep, "selected": selected}
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    save_json(out_path, out, indent=1)
+    log("selected threshold %.4f (skip %.0f%%, blended video mAP %.4f) "
+        "-> %s" % (selected["threshold"],
+                   100 * selected["tile_skip_rate"],
+                   selected["blended_video_mAP"], out_path))
+    print(json.dumps({"tool": "quality_matrix", "streams": True,
+                      "full_video_mAP": full_map,
+                      "selected": selected, "sweep_points": len(sweep),
+                      "out": out_path}))
+
+
 def main() -> None:
     only = None
     for i, a in enumerate(sys.argv):
         if a == "--only" and i + 1 < len(sys.argv):
             only = set(sys.argv[i + 1].split(","))
+
+    if "--streams" in sys.argv:
+        run_streams("--smoke" in sys.argv)
+        return
 
     if "--cascade" in sys.argv:
         run_cascade("--smoke" in sys.argv)

@@ -95,6 +95,34 @@ for END-TO-END request latency because the result fetch is a real D2H.
   never a lost ack. The ONE JSON line gains
   `cascade`/`escalation_rate`/`cascade_goodput_ratio` fields.
 
+* **streams mode (`--streams`, ISSUE 17)** — delta-gated tile inference
+  vs full-inference for N seeded synthetic camera streams
+  (`serving/streams.py` sessions over a FleetRouter of simulated
+  PER-TILE-service tile replicas — host waits only, the CPU-valid
+  signal as in fleet/cascade mode, but a bucket-b batch costs b x
+  `--tile-sim-ms`: tile convs are compute-bound, so device time is
+  linear in the padded batch and capacity is tiles/s — skipped tiles
+  buy real headroom and batching buys none, which makes the closed-loop
+  capacity the true saturation rate), over the SAME seeded
+  frame-arrival trace at the SAME offered frame rate
+  (`serve_bench_streams.json`, schema **serve-bench-streams-v1**). Each
+  stream's frames share `--redundancy` of their tiles frame-to-frame;
+  the full-inference arm runs the SAME session/tile path with the
+  threshold forced below zero (every tile computes), so the comparison
+  isolates the gating alone. Offered load is `--stream-load`x the full
+  arm's measured closed-loop capacity (past its saturation by
+  construction, within the gated arm's): frame goodput counts
+  frames delivered on time with ZERO degraded tiles, and the
+  `stream_goodput_ratio` >= 2.0 gate (`gate_streams_2x`) is the
+  artifact's headline, ratchet-gated by perfgate in the `eff` class
+  next to `computed_tile_fraction` (the compute the gating actually
+  spent). A frame-fault replay section (`stream:frame` dropped/late/
+  corrupt frames over STREAM_SITES) pins the acknowledged-frame
+  contract: gaps answer from the tile cache with `recover:frame-gap`
+  events, corrupt frames are quarantined, lost_acks must be 0. The ONE
+  JSON line gains `streams`/`computed_tile_fraction`/
+  `stream_goodput_ratio` fields.
+
 * **tail exemplars (`--trace-exemplars N`, ISSUE 14)** — the load run
   records trace contexts (obs/trace.py rides the engine/fleet span
   taxonomy; a temp span log is armed automatically when none is
@@ -147,6 +175,7 @@ from real_time_helmet_detection_tpu.utils import save_json  # noqa: E402
 SCHEMA = "serve-bench-v1"
 FLEET_SCHEMA = "serve-bench-fleet-v1"
 CASCADE_SCHEMA = "serve-bench-cascade-v1"
+STREAMS_SCHEMA = "serve-bench-streams-v1"
 HB = maybe_job_heartbeat()
 
 
@@ -949,6 +978,392 @@ def run_cascade_bench(args) -> Dict:
 
 
 # ---------------------------------------------------------------------------
+# streams harness (ISSUE 17)
+
+
+# per-tile sim output shaped EXACTLY like ops.decode.Detections (same
+# field names, same order) so the stream session's smooth/stitch path
+# treats sim tiles like real ones; every leaf is a pure function of the
+# image bytes, so identical frame bytes give identical detections and
+# the A/B arms are comparable row for row
+_SimTileDetections = collections.namedtuple(
+    "_SimTileDetections", "boxes classes scores valid")
+
+_SIM_TILE_ROWS = 4
+
+
+class _SimStreamCompiled(_SimCompiled):
+    def __call__(self, variables, images):
+        # per-TILE service: a bucket-b batch costs b x the tile time.
+        # Tile convs at these sizes are compute-bound, so device time is
+        # ~linear in the (padded) batch — a fixed per-batch service
+        # would hand the full-inference arm free batching and the A/B
+        # would measure router behavior, not compute savings.
+        time.sleep(self.service_s * self.b)
+        imgs = np.asarray(images)
+        k = _SIM_TILE_ROWS
+        base = imgs[:, :k, 0, 0].astype(np.float32)
+        boxes = np.stack([base, base, base + 4.0, base + 4.0], axis=-1)
+        classes = (imgs[:, :k, 1, 0] % 2).astype(np.int32)
+        scores = imgs[:, :k, 2, 0].astype(np.float32) / 255.0
+        valid = np.ones((self.b, k), bool)
+        return _SimTileDetections(boxes, classes, scores, valid)
+
+
+class SimStreamPredict(SimServePredict):
+    """Tile-replica sim predict: per-TILE service time (a bucket-b
+    batch sleeps b x `service_ms` — the compute-bound conv model, so
+    capacity is tiles/s and skipping tiles buys real headroom),
+    Detections-shaped output derived from the tile bytes (deterministic
+    — the stream A/B arms see the same rows for the same tiles)."""
+
+    def lower(self, variables, spec):
+        b, service_s = spec.shape[0], self.service_s
+
+        class _Lowered:
+            def compile(self):
+                return _SimStreamCompiled(b, service_s)
+
+        return _Lowered()
+
+
+def synth_stream_frames(args, sid: int, n_frames: int) -> List[np.ndarray]:
+    """One seeded synthetic camera stream: frame 0 is random uint8; each
+    later frame keeps every tile with probability `--redundancy` and
+    re-randomizes it otherwise — the controlled-redundancy fixture the
+    gating claim is measured on. Per-stream seed, so streams differ but
+    both A/B arms replay the IDENTICAL sequences."""
+    from real_time_helmet_detection_tpu.ops.delta import tile_origins
+    rng = np.random.default_rng(args.seed * 1000 + 77 + sid)
+    g = args.tile_grid
+    fshape = (g * args.imsize, g * args.imsize, 3)
+    origins = tile_origins(fshape, g)
+    frames = [rng.integers(0, 256, fshape, dtype=np.uint8)]
+    while len(frames) < n_frames:
+        nxt = frames[-1].copy()
+        for (y0, x0) in origins:
+            if rng.random() >= args.redundancy:
+                nxt[y0:y0 + args.imsize, x0:x0 + args.imsize] = \
+                    rng.integers(0, 256, (args.imsize, args.imsize, 3),
+                                 dtype=np.uint8)
+        frames.append(nxt)
+    return frames
+
+
+def stream_closed_loop(sessions, seqs, duration_s: float,
+                       tracer=None) -> Dict:
+    """Each stream submits back-to-back (next frame when the previous
+    delivers): the session path's saturation capacity in frames/s — the
+    anchor the open-loop offered rate multiplies."""
+    from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
+    tracer = tracer or maybe_tracer()
+    stop = threading.Event()
+    lock = threading.Lock()
+    done = [0]
+
+    def cam(si: int) -> None:
+        sess, frames = sessions[si], seqs[si]
+        k = 0
+        while not stop.is_set():
+            fut = sess.submit_frame(frames[k % len(frames)])
+            k += 1
+            try:
+                fut.result(timeout=60)
+            except Exception:  # noqa: BLE001 — closing down
+                return
+            with lock:
+                done[0] += 1
+
+    threads = [threading.Thread(target=cam, args=(i,), daemon=True)
+               for i in range(len(sessions))]
+    with tracer.span("serve-bench:stream-closed",
+                     streams=len(sessions)) as sp:
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+    wall = sp.dur_s
+    return {"mode": "stream-closed", "streams": len(sessions),
+            "duration_s": round(wall, 2), "frames": done[0],
+            "goodput_fps": round(done[0] / wall, 2)}
+
+
+def stream_open_loop(sessions, seqs, schedules, duration_s: float,
+                     deadline_s: float, offered_fps: float,
+                     mode: str) -> Dict:
+    """Seeded Poisson frame arrivals per stream; every frame is
+    acknowledged at submit and ALWAYS delivers (the session contract).
+    Frame goodput counts frames delivered on time with ZERO degraded
+    tiles — a degraded frame answered (from the cache) but its evidence
+    is stale, so it does not earn goodput. `lost` counts frames whose
+    future never delivered: the quantity the chaos selfcheck and the
+    artifact gate pin at ZERO. Completion is stamped by the session's
+    delivery callback, so the latency is delivery time, not
+    collector-poll time."""
+    lock = threading.Lock()
+    rows: List = []   # (latency_s, degraded_tiles, gap)
+    lost = [0]
+    t0 = time.monotonic() + 0.05
+
+    def cam(si: int) -> None:
+        sess, frames, sched = sessions[si], seqs[si], schedules[si]
+        futs = []
+        for k, at in enumerate(sched):
+            lag = t0 + at - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+            arrive = t0 + at
+
+            def stamp(f, arrive=arrive):
+                # delivery latency from the future's own t_done stamp
+                # (the session's delivery thread writes it before the
+                # callback fires) — no hand-rolled span timing here
+                res = f.result(timeout=0)
+                with lock:
+                    rows.append((f.t_done - arrive,
+                                 res.degraded_tiles, res.gap))
+
+            fut = sess.submit_frame(frames[k % len(frames)])
+            fut.add_done_callback(stamp)
+            futs.append(fut)
+        grace = time.monotonic() + deadline_s + 3.0
+        for f in futs:
+            try:
+                f.result(timeout=max(0.1, grace - time.monotonic()))
+            except Exception:  # noqa: BLE001 — an undelivered frame
+                with lock:
+                    lost[0] += 1
+
+    threads = [threading.Thread(target=cam, args=(i,), daemon=True)
+               for i in range(len(sessions))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with lock:
+        got = list(rows)
+        nlost = lost[0]
+    lats = [lat for lat, _, _ in got]
+    ontime = sum(1 for lat, deg, gap in got
+                 if lat <= deadline_s and deg == 0 and not gap)
+    degraded = sum(1 for _, deg, _ in got if deg > 0)
+    n = sum(len(s) for s in schedules)
+    return {"mode": mode, "offered_fps": round(offered_fps, 2),
+            "duration_s": round(duration_s, 2), "n": n,
+            "completed": len(got), "ontime": ontime,
+            "degraded": degraded, "lost": nlost,
+            "deadline_ms": round(deadline_s * 1e3, 1),
+            "goodput_fps": round(ontime / duration_s, 2), **_lat_ms(lats)}
+
+
+def make_stream_fleet(args, tracer=None):
+    """Two simulated tile replicas behind the FleetRouter — the serving
+    surface both A/B arms share (`make_replica_factory` is THE
+    sanctioned construction point)."""
+    return FleetRouter(
+        make_replica_factory(SimStreamPredict(args.tile_sim_ms),
+                             {"w": np.zeros(1)}, args.imsize,
+                             tuple(sorted(set(args.buckets))),
+                             queue_capacity=max(args.queue_cap, 64),
+                             max_wait_ms=args.max_wait_ms,
+                             depth=args.depth, tracer=tracer),
+        2, metrics=MetricsRegistry(), default_budget=1_000_000,
+        tracer=tracer)
+
+
+def make_stream_sessions(args, router, threshold: float, deadline_s,
+                         injector=None, tracer=None):
+    from real_time_helmet_detection_tpu.serving import StreamSession
+    g = args.tile_grid
+    fshape = (g * args.imsize, g * args.imsize, 3)
+    return [StreamSession(router, fshape, grid=g, threshold=threshold,
+                          deadline_s=deadline_s, injector=injector,
+                          tracer=tracer, sid=sid)
+            for sid in range(args.streams_n)]
+
+
+def stream_fault_run(args, tracer) -> Dict:
+    """The frame-fault acceptance run: dropped/late/corrupt frames fire
+    mid-stream (`stream:frame` site; `--faults` / the `seed=N` shorthand
+    overrides, drawn over STREAM_SITES) and every acknowledged frame
+    still delivers — gaps answer from the tile cache with
+    `recover:frame-gap` events, corrupt frames are quarantined (never
+    the delta reference). lost_acks must be 0."""
+    from real_time_helmet_detection_tpu.runtime.faults import STREAM_SITES
+    spec = (args.faults or "").strip()
+    if spec.startswith("seed="):
+        opts = dict(p.split("=", 1) for p in spec.split(",") if "=" in p)
+        sched = FaultSchedule.seeded(int(opts["seed"]),
+                                     n=int(opts.get("n", 3)),
+                                     sites=STREAM_SITES, max_at=10)
+    elif spec:
+        sched = FaultSchedule.parse(spec)
+    else:
+        sched = FaultSchedule.parse("stream:frame=dropped-frame@2,"
+                                    "stream:frame=corrupt-frame@5,"
+                                    "stream:frame=late-frame@8")
+    inj = ChaosInjector(sched, tracer=tracer)
+    router = make_stream_fleet(args, tracer)
+    from real_time_helmet_detection_tpu.serving import StreamSession
+    g = args.tile_grid
+    sess = StreamSession(router, (g * args.imsize, g * args.imsize, 3),
+                         grid=g, threshold=args.stream_threshold,
+                         injector=inj, tracer=tracer, sid=0)
+    frames = synth_stream_frames(args, 0, 12)
+    futs = [sess.submit_frame(f) for f in frames]
+    lost = 0
+    for f in futs:
+        try:
+            f.result(timeout=120)
+        except Exception:  # noqa: BLE001 — a lost acknowledged frame
+            lost += 1
+    st = sess.stats()
+    sess.close()
+    router.close()
+    out = {"spec": inj.schedule.spec(), "injected": inj.summary(),
+           "frames": len(futs), "lost_acks": lost, "gaps": st["gaps"],
+           "corrupt": st["corrupt"], "late": st["late"],
+           "degraded_tiles": st["degraded_tiles"]}
+    log("stream faults: %d injected, gaps %d, corrupt %d, late %d, "
+        "lost acks %d" % (out["injected"]["total"], out["gaps"],
+                          out["corrupt"], out["late"], out["lost_acks"]))
+    return out
+
+
+def run_streams_bench(args) -> Dict:
+    """Delta-gated vs full-inference streaming at the SAME offered frame
+    rate over the SAME seeded frame sequences and arrival trace (module
+    docstring, streams-mode note). Sections: full-inference capacity
+    (closed loop) -> one overload open-loop row per arm -> the
+    frame-fault replay -> trace completeness over the whole run."""
+    jax, devs = acquire_backend()
+    platform = devs[0].platform
+    log("backend up: %s (streams mode)" % platform)
+    HB.beat("backend up (%s, streams)" % platform)
+    from real_time_helmet_detection_tpu.obs.spans import maybe_tracer
+    tracer = arm_trace_log(args, maybe_tracer(args.span_log or None))
+
+    n_tiles = args.tile_grid * args.tile_grid
+    out: Dict = {"schema": STREAMS_SCHEMA, "tool": "serve_bench",
+                 "platform": platform, "imsize": args.imsize,
+                 "tile_grid": args.tile_grid, "tiles": n_tiles,
+                 "streams": args.streams_n,
+                 "redundancy": args.redundancy,
+                 "stream_threshold": args.stream_threshold,
+                 "tile_sim_ms": args.tile_sim_ms,
+                 "stream_load": args.stream_load,
+                 "deadline_ms": args.deadline_ms, "seed": args.seed,
+                 "note": ("both arms run the SAME StreamSession/tile "
+                          "path over simulated per-tile-service tile "
+                          "replicas (host waits only — the CPU-valid "
+                          "signal, fleet-mode note; service is linear "
+                          "in the padded batch, so capacity is tiles/s "
+                          "and the closed-loop anchor is the true "
+                          "saturation rate); the full arm "
+                          "forces the threshold below zero so every "
+                          "tile computes, same seeded frame sequences "
+                          "and Poisson trace at the same offered rate")}
+    deadline_s = args.deadline_ms / 1e3
+    seqs = [synth_stream_frames(args, sid, 128)
+            for sid in range(args.streams_n)]
+
+    # full-inference capacity, closed loop (threshold -1: every tile
+    # computes through the same gated code path)
+    router = make_stream_fleet(args, tracer)
+    sess = make_stream_sessions(args, router, -1.0, deadline_s,
+                                tracer=tracer)
+    try:
+        closed = stream_closed_loop(sess, seqs,
+                                    max(2.0, args.duration / 2), tracer)
+    finally:
+        for s in sess:
+            s.close()
+        router.close()
+    cap = max(closed["goodput_fps"], 1e-6)
+    out["full_capacity_fps"] = closed["goodput_fps"]
+    log("full-inference capacity: %.1f frames/s (%d streams, closed "
+        "loop)" % (cap, args.streams_n))
+    HB.beat("stream capacity measured")
+    rate = args.stream_load * cap
+    out["offered_fps"] = round(rate, 2)
+    schedules = [arrival_schedule(rate / args.streams_n, args.duration,
+                                  args.seed + 1700 + sid)
+                 for sid in range(args.streams_n)]
+
+    # full-inference arm over the trace
+    router = make_stream_fleet(args, tracer)
+    sess = make_stream_sessions(args, router, -1.0, deadline_s,
+                                tracer=tracer)
+    try:
+        row_full = stream_open_loop(sess, seqs, schedules, args.duration,
+                                    deadline_s, rate, "full-inference")
+    finally:
+        for s in sess:
+            s.close()
+        router.close()
+    log("full-inference at %.1f fps offered: goodput %.1f, p99 %s ms, "
+        "degraded %d" % (rate, row_full["goodput_fps"],
+                         row_full["p99_ms"], row_full["degraded"]))
+    HB.beat("full-inference row done")
+
+    # delta-gated arm over the SAME trace (identical schedule objects)
+    router = make_stream_fleet(args, tracer)
+    sess = make_stream_sessions(args, router, args.stream_threshold,
+                                deadline_s, tracer=tracer)
+    try:
+        row_gated = stream_open_loop(sess, seqs, schedules, args.duration,
+                                     deadline_s, rate, "delta-gated")
+        stats_g = [s.stats() for s in sess]
+    finally:
+        for s in sess:
+            s.close()
+        router.close()
+    computed = sum(st["computed_tiles"] for st in stats_g)
+    skipped = sum(st["skipped_tiles"] for st in stats_g)
+    out["computed_tile_fraction"] = round(
+        computed / max(computed + skipped, 1), 4)
+    out["tile_skip_rate"] = round(
+        skipped / max(computed + skipped, 1), 4)
+    out["rows"] = [row_gated, row_full]
+    ratio = row_gated["goodput_fps"] / max(row_full["goodput_fps"], 1e-6)
+    out["stream_goodput_ratio"] = round(ratio, 2)
+    out["gate_streams_2x"] = bool(ratio >= 2.0)
+    log("delta-gated at the same %.1f fps: goodput %.1f vs %.1f full "
+        "(%.2fx, computed tile fraction %.1f%%, gate_streams_2x=%s)"
+        % (rate, row_gated["goodput_fps"], row_full["goodput_fps"],
+           ratio, 100 * out["computed_tile_fraction"],
+           out["gate_streams_2x"]))
+    HB.beat("delta-gated row done")
+
+    out["faults"] = stream_fault_run(args, tracer)
+    HB.beat("stream fault run done")
+    out["gate_zero_lost_acks"] = bool(
+        row_gated["lost"] == 0 and row_full["lost"] == 0
+        and out["faults"]["lost_acks"] == 0)
+
+    exemplars, tsummary = trace_sections(tracer, args.trace_exemplars)
+    if exemplars is not None:
+        out["trace_exemplars"] = exemplars
+        out["trace_summary"] = tsummary
+        if exemplars["exemplars"]:
+            out["exemplar_p99_stage"] = \
+                exemplars["exemplars"][0]["critical_path"]["dominant_stage"]
+        out["gate_traces_complete"] = bool(
+            tsummary["orphans"] == 0 and tsummary["broken_chains"] == 0
+            and tsummary["request_traces"] > 0)
+        log("trace gate: %d request traces, orphans %d, broken %d, "
+            "p99 stage %s" % (tsummary["request_traces"],
+                              tsummary["orphans"],
+                              tsummary["broken_chains"],
+                              out.get("exemplar_p99_stage")))
+    log("stream gates: 2x goodput %s, zero lost acks %s"
+        % (out["gate_streams_2x"], out["gate_zero_lost_acks"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # harness assembly
 
 
@@ -1659,6 +2074,116 @@ def selfcheck() -> int:
         print("selfcheck cascade section elapsed %.1fs"
               % sp_c.close(), file=sys.stderr, flush=True)
 
+        # ---- streaming sessions (ISSUE 17): delta-gated tile inference
+        # over REAL predicts — gate-off bit-identity vs the whole-frame
+        # predict, tile reassembly bit-identical to the per-tile oracle,
+        # static tiles answered from the cache, in-order delivery, zero
+        # lost acked frames under the canned frame-fault schedule --------
+        from real_time_helmet_detection_tpu.ops.delta import (
+            stitch_detections, tile_origins)
+        from real_time_helmet_detection_tpu.serving import StreamSession
+        sp_st = maybe_tracer(None).span(
+            "serve-bench:selfcheck-streams").__enter__()
+        det_fields = ("boxes", "classes", "scores", "valid")
+
+        def mk_frame(i0, i1, i2, i3):
+            # a 2x2 frame whose tiles are pool images — so the per-tile
+            # oracle is the one-shot oracle already computed above
+            top = np.concatenate([pool[i0], pool[i1]], axis=1)
+            bot = np.concatenate([pool[i2], pool[i3]], axis=1)
+            return np.concatenate([top, bot], axis=0)
+
+        def frame_equal(det, want):
+            return all(np.array_equal(getattr(det, n), getattr(want, n))
+                       for n in det_fields)
+
+        origins_st = tile_origins((128, 128, 3), 2)
+        eng_st = ServingEngine(predict, variables, (64, 64, 3), np.uint8,
+                               buckets=(1, 2, 4), max_wait_ms=2.0,
+                               depth=2, queue_capacity=32, tracer=tracer)
+        eng_st.predict_many(pool[:2])  # warm the tile buckets
+        # ema=0 isolates the reassembly arithmetic (smoothing determinism
+        # has its own test in tests/test_streams.py)
+        sess_st = StreamSession(eng_st, (128, 128, 3), grid=2,
+                                threshold=1.0, ema=0.0, tracer=tracer)
+        f0, f1 = mk_frame(0, 1, 2, 3), mk_frame(0, 1, 4, 3)
+        r0 = sess_st.submit_frame(f0).result(timeout=60)
+        check("streams: first frame computes every tile",
+              r0.computed_tiles == 4 and r0.total_tiles == 4)
+        check("streams: reassembly bit-identical to per-tile oracle",
+              frame_equal(r0.detections,
+                          stitch_detections([oracle[i] for i in
+                                             (0, 1, 2, 3)], origins_st)))
+        r1 = sess_st.submit_frame(f1).result(timeout=60)
+        check("streams: only the changed tile recomputes",
+              r1.computed_tiles == 1
+              and frame_equal(r1.detections,
+                              stitch_detections([oracle[i] for i in
+                                                 (0, 1, 4, 3)],
+                                                origins_st)))
+        r2 = sess_st.submit_frame(f1).result(timeout=60)
+        check("streams: identical frame answers fully from the cache",
+              r2.computed_tiles == 0
+              and frame_equal(r2.detections, r1.detections))
+        sess_st.close()
+
+        # gate-off bit-identity: the WHOLE frame passes straight through
+        # (no delta program, no stitching) — the exact pre-gating answer
+        eng_off = ServingEngine(predict, variables, (128, 128, 3),
+                                np.uint8, buckets=(1,), max_wait_ms=0.0,
+                                queue_capacity=8, tracer=tracer)
+        pend_off = predict(variables, f0[None])
+        whole = type(pend_off)(*(np.asarray(leaf[0]) for leaf in
+                                 jax.device_get(pend_off)))
+        sess_off = StreamSession(eng_off, (128, 128, 3), gate=False,
+                                 tracer=tracer)
+        roff = sess_off.submit_frame(f0).result(timeout=60)
+        check("streams: gate-off bit-identical to whole-frame predict",
+              frame_equal(roff.detections, whole)
+              and roff.computed_tiles == roff.total_tiles)
+        sess_off.close()
+        eng_off.close()
+
+        # frame faults: dropped@2 / corrupt@3 / late@5 over one stream —
+        # every acknowledged frame delivers (gaps from the cache), the
+        # corrupt frame never becomes the delta reference
+        injst = ChaosInjector(FaultSchedule.parse(
+            "stream:frame=dropped-frame@2,stream:frame=corrupt-frame@3,"
+            "stream:frame=late-frame@5"), tracer=tracer)
+        sess_f = StreamSession(eng_st, (128, 128, 3), grid=2,
+                               threshold=1.0, ema=0.0, injector=injst,
+                               tracer=tracer, sid=1)
+        seq_frames = [mk_frame(0, 1, 2, 3), mk_frame(0, 1, 4, 3),
+                      mk_frame(5, 1, 4, 3), mk_frame(5, 6, 4, 3),
+                      mk_frame(5, 6, 4, 7), mk_frame(5, 6, 4, 7)]
+        futs_f = [sess_f.submit_frame(f) for f in seq_frames]
+        lost_f, res_f = 0, []
+        for f in futs_f:
+            try:
+                res_f.append(f.result(timeout=60))
+            except Exception:  # noqa: BLE001 — would be a lost ack
+                lost_f += 1
+        st_f = sess_f.stats()
+        sess_f.close()
+        eng_st.close()
+        check("streams: zero lost acked frames under frame faults",
+              lost_f == 0 and len(res_f) == 6 and injst.pending() == 0)
+        check("streams: in-order delivery",
+              [r.seq for r in res_f] == list(range(6)))
+        check("streams: dropped/corrupt frames answer from the cache",
+              res_f[1].gap and res_f[2].gap
+              and frame_equal(res_f[1].detections, res_f[0].detections)
+              and frame_equal(res_f[2].detections, res_f[0].detections))
+        check("streams: frame-fault accounting",
+              st_f["gaps"] == 2 and st_f["corrupt"] == 1
+              and st_f["late"] == 1)
+        gap_events = [s for s in read_spans(span_path)
+                      if s.get("name") == "recover:frame-gap"]
+        check("streams: recover:frame-gap events in the span log",
+              len(gap_events) >= 2)
+        print("selfcheck streams section elapsed %.1fs"
+              % sp_st.close(), file=sys.stderr, flush=True)
+
     ok = not failures
     print(json.dumps({"tool": "serve_bench", "selfcheck": True, "ok": ok,
                       "failures": failures,
@@ -1766,6 +2291,44 @@ def main(argv=None) -> int:
                         "ceiling — keep well past it: the "
                         "gate_cascade_2x headline is claimed at an "
                         "offered load the baseline saturates under)")
+    p.add_argument("--streams", action="store_true",
+                   help="streams mode (ISSUE 17): delta-gated tile "
+                        "inference vs full-inference for N synthetic "
+                        "camera streams over the same seeded frame trace "
+                        "at the same offered rate; writes the "
+                        "serve-bench-streams-v1 artifact "
+                        "(serve_bench_streams.json)")
+    p.add_argument("--streams-n", type=int, default=4,
+                   help="number of synthetic camera streams")
+    p.add_argument("--redundancy", type=float, default=0.75,
+                   help="per-tile probability a tile is UNCHANGED frame-"
+                        "to-frame in the synthetic streams (the "
+                        "controlled-redundancy fixture the gating claim "
+                        "is measured at)")
+    p.add_argument("--stream-threshold", type=float, default=1.0,
+                   help="tile skip threshold (mean |delta| in [0, 255]) "
+                        "for the SIM streams: any value between 0 and a "
+                        "re-randomized tile's ~85 separates cleanly. "
+                        "Real-parts serving resolves its threshold from "
+                        "the calibrated quality_matrix --streams "
+                        "artifact via config.stream_overrides instead")
+    p.add_argument("--tile-grid", type=int, default=2,
+                   help="frame tiling (grid x grid tiles, each the "
+                        "engine's image size)")
+    p.add_argument("--stream-load", type=float, default=2.5,
+                   help="streams rows' offered frame rate as a multiple "
+                        "of the full arm's measured closed-loop capacity "
+                        "(per-tile service makes that the TRUE "
+                        "saturation rate — batching buys no throughput; "
+                        "keep 1 < load < 1/computed-fraction so the "
+                        "full arm saturates while the gated arm fits)")
+    p.add_argument("--tile-sim-ms", type=float, default=10.0,
+                   help="streams rows: simulated PER-TILE service time "
+                        "(a bucket-b tile batch costs b x this — the "
+                        "compute-bound conv model under which skipped "
+                        "tiles buy real capacity; fixed per-batch "
+                        "service would measure the router, not the "
+                        "compute savings)")
     p.add_argument("--tenants", default="bulk:64,flagged:64",
                    help="fleet canary run's tenant mix as "
                         "'name:budget,...' (per-tenant counters ride "
@@ -1817,7 +2380,12 @@ def main(argv=None) -> int:
         name, _, budget = part.partition(":")
         args.tenant_budgets[name] = int(budget or 64)
 
-    if args.cascade:
+    if args.streams:
+        out = run_streams_bench(args)
+        path = args.out or os.path.join(REPO, "artifacts", graft_round(),
+                                        "serving",
+                                        "serve_bench_streams.json")
+    elif args.cascade:
         out = run_cascade_bench(args)
         path = args.out or os.path.join(REPO, "artifacts", graft_round(),
                                         "serving",

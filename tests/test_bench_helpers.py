@@ -519,3 +519,33 @@ def test_chained_scan_step_samples_threads_donated_state():
     clamped, _ = bench.chained_scan_step_samples(
         compiled, final, (jnp.ones((8, 8)),), overhead=1e9, chunks=1)
     assert clamped == [1e-9]
+
+
+def test_find_last_tpu_result_carries_stream_fields(tmp_path):
+    """ISSUE 17 satellite: the BENCH_STREAM JSON-line fields
+    (stream/tile_skip_rate/stream_fps) ride find_last_tpu_result, and
+    bench_stream_of hands a consumer the full triple."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r17", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1300.0,
+        "stream": True, "tile_skip_rate": 0.62, "stream_fps": 210.5})
+    got = bench.find_last_tpu_result(root)
+    assert got["stream"] is True
+    assert got["tile_skip_rate"] == 0.62
+    assert got["stream_fps"] == 210.5
+    # pre-existing consumer contract unchanged
+    assert got["value"] == 1300.0
+    assert bench.bench_stream_of(got) == {
+        "stream": True, "tile_skip_rate": 0.62, "stream_fps": 210.5}
+
+
+def test_find_last_tpu_result_old_lines_lack_stream_keys(tmp_path):
+    """Pre-stream lines carry no stream keys and parse as stream-off
+    through bench_stream_of (the back-compat contract)."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r09", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1100.0})
+    got = bench.find_last_tpu_result(root)
+    assert "stream" not in got and "stream_fps" not in got
+    assert bench.bench_stream_of(got) == {
+        "stream": False, "tile_skip_rate": None, "stream_fps": None}

@@ -237,6 +237,95 @@ def test_cascade_site_registered_and_seedable():
     assert all(e.site == "fleet:escalate" for e in a)
 
 
+def test_stream_site_registered_and_seedable():
+    """ISSUE 17: the stream:frame chaos site is first-class — in
+    ALL_SITES with its three frame-fault kinds (dropped-frame /
+    late-frame / corrupt-frame — the camera-side failure modes the
+    StreamSession must absorb without losing an acked frame), and
+    seeded schedules draw it replayably like every other site."""
+    from real_time_helmet_detection_tpu.runtime.faults import (
+        ALL_SITES, SITE_KINDS, STREAM_SITES)
+    assert STREAM_SITES == ("stream:frame",)
+    assert set(STREAM_SITES) <= set(ALL_SITES)
+    assert set(SITE_KINDS["stream:frame"]) == {
+        "dropped-frame", "late-frame", "corrupt-frame"}
+    a = FaultSchedule.seeded(13, n=3, sites=STREAM_SITES)
+    assert a.spec() == FaultSchedule.seeded(13, n=3,
+                                            sites=STREAM_SITES).spec()
+    assert all(e.site == "stream:frame" for e in a)
+
+
+class _StreamFakeFut:
+    def __init__(self, value):
+        self._value = value
+
+    def result(self, timeout=None):
+        return self._value
+
+
+class _StreamFakeServer:
+    """Deterministic submit surface for stream chaos: the answer is a
+    pure function of the submitted bytes (engine-backed bit-identity is
+    serve_bench --selfcheck's job; here the session's own fault
+    absorption is the contract under test)."""
+
+    def submit(self, image, block=False, deadline_s=None, **kw):
+        from real_time_helmet_detection_tpu.ops.decode import Detections
+        img = np.asarray(image)
+        base = img[:4, 0, 0].astype(np.float32)
+        return _StreamFakeFut(Detections(
+            boxes=np.stack([base, base, base + 4.0, base + 4.0],
+                           axis=-1),
+            classes=(img[:4, 1, 0].astype(np.int32) % 2),
+            scores=img[:4, 2, 0].astype(np.float32) / 255.0,
+            valid=np.ones((4,), bool)))
+
+
+@pytest.mark.parametrize("seed", [2, 5, 8])
+def test_stream_frame_faults_zero_lost_acked_frames(seed):
+    """THE stream acceptance row: under a seeded stream:frame schedule
+    every submitted frame DELIVERS in order (dropped/corrupt frames
+    answer from the tile cache as gaps — never a lost ack, and a
+    corrupt frame never becomes the delta reference), and the session
+    accounting matches the schedule exactly."""
+    from real_time_helmet_detection_tpu.runtime.faults import STREAM_SITES
+    from real_time_helmet_detection_tpu.serving.streams import \
+        StreamSession
+    sched = FaultSchedule.seeded(seed, n=3, sites=STREAM_SITES,
+                                 max_at=10)
+    inj = ChaosInjector(sched)
+    n_gap = sum(1 for e in sched
+                if e.kind in ("dropped-frame", "corrupt-frame"))
+    n_corrupt = sum(1 for e in sched if e.kind == "corrupt-frame")
+    n_late = sum(1 for e in sched if e.kind == "late-frame")
+    sess = StreamSession(_StreamFakeServer(), (IMSIZE, IMSIZE, 3),
+                         grid=2, threshold=1.0, ema=0.0, injector=inj)
+    rng = np.random.default_rng(seed)
+    try:
+        futs = [sess.submit_frame(
+            rng.integers(0, 256, (IMSIZE, IMSIZE, 3), np.uint8))
+            for _ in range(12)]
+        results = [f.result(timeout=60) for f in futs]
+        assert [r.seq for r in results] == list(range(12))  # in order,
+        # every ack delivered
+        assert inj.pending() == 0  # the whole schedule fired
+        st = sess.stats()
+        assert st["delivered"] == 12
+        assert st["gaps"] == n_gap
+        assert st["corrupt"] == n_corrupt
+        assert st["late"] == n_late
+        # a gap frame answers from the cache: bit-identical to the
+        # previous delivered detections
+        for i, r in enumerate(results):
+            if r.gap and i > 0:
+                prev = results[i - 1].detections
+                for name in prev._fields:
+                    assert np.array_equal(getattr(r.detections, name),
+                                          getattr(prev, name))
+    finally:
+        sess.close()
+
+
 def test_fleet_replica_death_acceptance(serve_parts):
     """THE fleet acceptance row: an injected fleet:replica worker-death
     plus a fleet:dispatch device-loss against a live 2-replica router

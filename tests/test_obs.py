@@ -502,8 +502,8 @@ def test_obs_report_selfcheck_end_to_end():
 def test_obs_report_joins_real_spool_journal(tmp_path):
     """Acceptance: the report reads a journal written by the REAL tpu_queue
     spool (not a hand-rolled fixture), plus tracer spans and a bench line,
-    into one obs-report-v6 object (the ISSUE-16 schema; a round with no
-    metrics export/scaling/fleet/trace activity just nulls those
+    into one obs-report-v7 object (the ISSUE-17 schema; a round with no
+    metrics export/scaling/fleet/trace/stream activity just nulls those
     sections)."""
     from real_time_helmet_detection_tpu.runtime.spool import JobSpec, Spool
     sys.path.insert(0, os.path.join(REPO, "scripts"))
@@ -535,11 +535,12 @@ def test_obs_report_joins_real_spool_journal(tmp_path):
         round="r99", span_log=[span_path],
         queue_dir=str(tmp_path / "queue"), bench=[bench_path],
         loss_log=[], out=str(tmp_path / "out")))
-    assert rep["schema"] == "obs-report-v6"
+    assert rep["schema"] == "obs-report-v7"
     assert rep["metrics"] is None and rep["slo"] is None  # nothing exported
     assert rep["scaling"] is None  # no scaling activity this round
     assert rep["fleet"] is None  # no fleet activity this round
     assert rep["traces"] is None  # no traced spans this round
+    assert rep["streams"] is None  # no stream activity this round
     assert rep["queue"]["jobs"]["bench"]["state"] == "done"
     assert rep["spans"]["by_name"]["step"]["count"] == 2
     assert rep["bench"][0]["recompile_count"] == 2

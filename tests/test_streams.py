@@ -1,0 +1,395 @@
+"""Streaming-video primitives (ISSUE 17): the in-jit tile delta
+summary, the StreamSession gating/reassembly/ordering contracts, the
+host-side EMA/track smoothing, and the calibrated skip-threshold
+promotion record (`config.stream_overrides`) — all CPU, no chip.
+
+The engine-backed bit-identity and frame-fault acceptance runs live in
+`scripts/serve_bench.py --selfcheck` (real predicts); seeded
+`stream:frame` chaos in tests/test_chaos.py. This file covers the
+pieces those build on, over a deterministic fake server so each
+contract is isolated from engine scheduling.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from real_time_helmet_detection_tpu import config as config_mod
+from real_time_helmet_detection_tpu.ops.decode import Detections
+from real_time_helmet_detection_tpu.ops.delta import (make_delta_fn,
+                                                      stitch_detections,
+                                                      tile_delta_summary,
+                                                      tile_origins,
+                                                      tile_shape)
+from real_time_helmet_detection_tpu.serving.streams import (StreamSession,
+                                                            smooth_tile)
+
+
+# ---------------------------------------------------------------------------
+# tile_delta_summary: the one (T,) leaf every gating decision reads
+
+
+def test_delta_identical_frames_is_zero():
+    f = np.random.default_rng(0).integers(0, 256, (64, 64, 3), np.uint8)
+    d = np.asarray(tile_delta_summary(jnp.asarray(f), jnp.asarray(f), 2))
+    assert d.shape == (4,) and d.dtype == np.float32
+    assert np.all(d == 0.0)
+
+
+def test_delta_no_uint8_wraparound():
+    """|250 - 5| must read 245, not the uint8-wrapped 11 — the cast
+    happens INSIDE the jitted program, before the subtract."""
+    a = np.full((32, 32, 3), 250, np.uint8)
+    b = np.full((32, 32, 3), 5, np.uint8)
+    d = np.asarray(tile_delta_summary(jnp.asarray(a), jnp.asarray(b), 2))
+    assert np.allclose(d, 245.0)
+
+
+def test_delta_localizes_to_the_changed_tile():
+    rng = np.random.default_rng(1)
+    prev = rng.integers(0, 256, (64, 64, 3), np.uint8)
+    cur = prev.copy()
+    th, tw = tile_shape((64, 64, 3), 2)
+    (y0, x0) = tile_origins((64, 64, 3), 2)[3]  # bottom-right tile
+    cur[y0:y0 + th, x0:x0 + tw] = rng.integers(0, 256, (th, tw, 3),
+                                               np.uint8)
+    d = np.asarray(tile_delta_summary(jnp.asarray(prev),
+                                      jnp.asarray(cur), 2))
+    assert np.all(d[:3] == 0.0) and d[3] > 10.0
+
+
+def test_delta_fn_matches_direct_call():
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, 256, (64, 64, 3), np.uint8)
+    b = rng.integers(0, 256, (64, 64, 3), np.uint8)
+    fn = make_delta_fn(2)
+    assert np.array_equal(np.asarray(fn(a, b)),
+                          np.asarray(tile_delta_summary(
+                              jnp.asarray(a), jnp.asarray(b), 2)))
+
+
+# ---------------------------------------------------------------------------
+# a deterministic fake server: the answer is a pure function of the
+# submitted bytes, futures optionally complete out of order
+
+
+def _det_for(img: np.ndarray) -> Detections:
+    img = np.asarray(img)
+    base = img[:4, 0, 0].astype(np.float32)
+    return Detections(
+        boxes=np.stack([base, base, base + 4.0, base + 4.0], axis=-1),
+        classes=(img[:4, 1, 0].astype(np.int32) % 2),
+        scores=img[:4, 2, 0].astype(np.float32) / 255.0,
+        valid=np.ones((4,), bool))
+
+
+class _FakeFut:
+    def __init__(self, value=None, error=None, hold=False):
+        self._value, self._error = value, error
+        self._event = threading.Event()
+        if not hold:
+            self._event.set()
+
+    def release(self):
+        self._event.set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("fake future held")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _FakeServer:
+    """submit(image, block=False, deadline_s=...) -> future, answering
+    _det_for(image); `hold=True` parks every future until release()."""
+
+    def __init__(self, hold=False, fail_at=()):
+        self.hold = hold
+        self.fail_at = set(fail_at)  # submit indices that error
+        self.submitted = []
+        self.futs = []
+
+    def submit(self, image, block=False, deadline_s=None, **kw):
+        i = len(self.submitted)
+        self.submitted.append(np.asarray(image).copy())
+        if i in self.fail_at:
+            f = _FakeFut(error=RuntimeError("injected request failure"),
+                         hold=self.hold)
+        else:
+            f = _FakeFut(value=_det_for(image), hold=self.hold)
+        self.futs.append(f)
+        return f
+
+
+def _frame(rng, hw=64):
+    return rng.integers(0, 256, (hw, hw, 3), np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# StreamSession contracts
+
+
+def test_gated_session_requires_threshold():
+    with pytest.raises(ValueError):
+        StreamSession(_FakeServer(), (64, 64, 3), grid=2)
+
+
+def test_gate_off_passes_the_whole_frame_through():
+    """gate=False: ONE submit per frame with the untouched frame bytes,
+    the server's answer delivered bit-identically (no delta program, no
+    stitching, no smoothing)."""
+    srv = _FakeServer()
+    sess = StreamSession(srv, (64, 64, 3), gate=False)
+    rng = np.random.default_rng(3)
+    frames = [_frame(rng) for _ in range(3)]
+    try:
+        for i, f in enumerate(frames):
+            res = sess.submit_frame(f).result(timeout=30)
+            want = _det_for(f)
+            assert len(srv.submitted) == i + 1
+            assert np.array_equal(srv.submitted[i], f)
+            for name in Detections._fields:
+                assert np.array_equal(getattr(res.detections, name),
+                                      getattr(want, name))
+            assert res.computed_tiles == res.total_tiles
+            assert not res.gap
+    finally:
+        sess.close()
+
+
+def test_first_frame_computes_all_then_static_skips():
+    srv = _FakeServer()
+    sess = StreamSession(srv, (64, 64, 3), grid=2, threshold=1.0,
+                         ema=0.0)
+    rng = np.random.default_rng(4)
+    f0 = _frame(rng)
+    try:
+        r0 = sess.submit_frame(f0).result(timeout=30)
+        assert r0.computed_tiles == 4 and len(srv.submitted) == 4
+        # identical frame: every tile static, zero new submits
+        r1 = sess.submit_frame(f0.copy()).result(timeout=30)
+        assert r1.computed_tiles == 0 and len(srv.submitted) == 4
+        for name in Detections._fields:
+            assert np.array_equal(getattr(r1.detections, name),
+                                  getattr(r0.detections, name))
+        st = sess.stats()
+        assert st["computed_tiles"] == 4 and st["skipped_tiles"] == 4
+        assert st["tile_skip_rate"] == 0.5
+    finally:
+        sess.close()
+
+
+def test_all_changed_frame_reassembles_to_the_tile_oracle():
+    """Every tile changed: the frame answer IS stitch_detections of the
+    per-tile answers at the tile origins (ema=0 isolates reassembly)."""
+    srv = _FakeServer()
+    sess = StreamSession(srv, (64, 64, 3), grid=2, threshold=1.0,
+                         ema=0.0)
+    rng = np.random.default_rng(5)
+    th, tw = tile_shape((64, 64, 3), 2)
+    origins = tile_origins((64, 64, 3), 2)
+    try:
+        f0 = _frame(rng)
+        sess.submit_frame(f0).result(timeout=30)
+        f1 = _frame(rng)  # fresh random: all four tiles changed
+        r1 = sess.submit_frame(f1).result(timeout=30)
+        assert r1.computed_tiles == 4
+        want = stitch_detections(
+            [_det_for(f1[y0:y0 + th, x0:x0 + tw])
+             for (y0, x0) in origins], origins)
+        for name in Detections._fields:
+            assert np.array_equal(getattr(r1.detections, name),
+                                  getattr(want, name))
+    finally:
+        sess.close()
+
+
+def test_in_order_delivery_under_out_of_order_completion():
+    """Tile futures completing in REVERSE order (retries, fleet
+    re-dispatch) must not reorder delivery: frames deliver strictly in
+    sequence, each seeing only its own frame's cache state."""
+    srv = _FakeServer(hold=True)
+    sess = StreamSession(srv, (64, 64, 3), grid=2, threshold=1.0,
+                         ema=0.0)
+    rng = np.random.default_rng(6)
+    delivered = []
+    try:
+        futs = [sess.submit_frame(_frame(rng)) for _ in range(3)]
+        for f in futs:
+            f.add_done_callback(
+                lambda fr: delivered.append(fr.result(timeout=0).seq))
+        # release the 12 tile futures newest-first
+        for fut in reversed(srv.futs):
+            fut.release()
+        for f in futs:
+            f.result(timeout=30)
+        assert delivered == [0, 1, 2]
+        assert [f.result(timeout=0).seq for f in futs] == [0, 1, 2]
+    finally:
+        sess.close()
+
+
+def test_failed_tile_degrades_to_cache_never_lost():
+    """A tile request that fails past the serving retry budget degrades
+    to the cached tile answer — the frame still delivers (zero lost
+    acks), the degradation is accounted."""
+    srv = _FakeServer(fail_at=(5,))  # one tile of the second frame
+    sess = StreamSession(srv, (64, 64, 3), grid=2, threshold=1.0,
+                         ema=0.0)
+    rng = np.random.default_rng(7)
+    try:
+        f0 = _frame(rng)
+        r0 = sess.submit_frame(f0).result(timeout=30)
+        r1 = sess.submit_frame(_frame(rng)).result(timeout=30)
+        assert r0.degraded_tiles == 0
+        assert r1.degraded_tiles == 1
+        assert sess.stats()["degraded_tiles"] == 1
+        assert sess.stats()["delivered"] == 2
+    finally:
+        sess.close()
+
+
+def test_future_timestamps_order():
+    srv = _FakeServer()
+    sess = StreamSession(srv, (64, 64, 3), gate=False)
+    rng = np.random.default_rng(8)
+    try:
+        fut = sess.submit_frame(_frame(rng))
+        fut.result(timeout=30)
+        assert fut.t_done is not None and fut.t_done >= fut.t_submit
+    finally:
+        sess.close()
+
+
+# ---------------------------------------------------------------------------
+# smooth_tile: deterministic EMA + center-distance association
+
+
+def _tile_det(boxes, classes, scores, valid=None):
+    boxes = np.asarray(boxes, np.float32).reshape(-1, 4)
+    n = len(boxes)
+    return Detections(
+        boxes=boxes, classes=np.asarray(classes, np.int32),
+        scores=np.asarray(scores, np.float32),
+        valid=(np.ones((n,), bool) if valid is None
+               else np.asarray(valid, bool)))
+
+
+def test_smooth_tile_ema_zero_returns_new_untouched():
+    new = _tile_det([[0, 0, 8, 8]], [1], [0.9])
+    prev = _tile_det([[0, 0, 8, 8]], [1], [0.1])
+    out = smooth_tile(new, prev, ema=0.0, radius=8.0)
+    assert np.array_equal(out.scores, new.scores)
+
+
+def test_smooth_tile_blends_matched_scores_keeps_new_geometry():
+    prev = _tile_det([[0, 0, 8, 8]], [1], [0.2])
+    new = _tile_det([[1, 1, 9, 9]], [1], [0.8])  # center moved ~1.4px
+    out = smooth_tile(new, prev, ema=0.5, radius=8.0)
+    assert out.scores[0] == pytest.approx(0.5 * 0.2 + 0.5 * 0.8)
+    assert np.array_equal(out.boxes, new.boxes)  # geometry is NEW's
+
+
+def test_smooth_tile_respects_class_and_radius():
+    prev = _tile_det([[0, 0, 8, 8], [40, 40, 48, 48]], [1, 1],
+                     [0.2, 0.3])
+    # same position, different class: no match; far away: no match
+    new = _tile_det([[0, 0, 8, 8], [40, 40, 48, 48]], [0, 1],
+                    [0.8, 0.7])
+    out = smooth_tile(new, prev, ema=0.5, radius=8.0)
+    assert out.scores[0] == pytest.approx(0.8)  # class mismatch: fresh
+    assert out.scores[1] == pytest.approx(0.5 * 0.3 + 0.5 * 0.7)
+    out2 = smooth_tile(new, prev, ema=0.5, radius=0.1)
+    # radius 0.1 still matches the exactly-overlapping track
+    assert out2.scores[1] == pytest.approx(0.5 * 0.3 + 0.5 * 0.7)
+
+
+def test_smooth_tile_deterministic():
+    rng = np.random.default_rng(9)
+    prev = _tile_det(rng.uniform(0, 32, (6, 4)), rng.integers(0, 2, 6),
+                     rng.uniform(size=6), rng.uniform(size=6) < 0.7)
+    new = _tile_det(rng.uniform(0, 32, (6, 4)), rng.integers(0, 2, 6),
+                    rng.uniform(size=6), rng.uniform(size=6) < 0.7)
+    a = smooth_tile(new, prev, ema=0.5, radius=8.0)
+    b = smooth_tile(new, prev, ema=0.5, radius=8.0)
+    for name in Detections._fields:
+        assert np.array_equal(getattr(a, name), getattr(b, name))
+
+
+# ---------------------------------------------------------------------------
+# stream_overrides: the committed calibration artifact IS the promotion
+# record (cascade_overrides idiom)
+
+
+def _write_calib(root, rnd, threshold):
+    d = os.path.join(root, "artifacts", rnd)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "streams.json"), "w") as f:
+        json.dump({"schema": "stream-calibration-v1",
+                   "selected": {"threshold": threshold}}, f)
+
+
+def test_stream_overrides_highest_round_wins(tmp_path):
+    root = str(tmp_path)
+    _write_calib(root, "r09", 11.0)
+    _write_calib(root, "r17", 25.5)
+    over = config_mod.stream_overrides(repo_root=root)
+    assert over["stream_threshold"] == 25.5
+    assert "r17" in over["_source"]
+
+
+def test_stream_overrides_missing_artifact_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        config_mod.stream_overrides(repo_root=str(tmp_path))
+
+
+def test_stream_overrides_tolerates_junk_artifacts(tmp_path):
+    root = str(tmp_path)
+    d = os.path.join(root, "artifacts", "r20")
+    os.makedirs(d)
+    with open(os.path.join(d, "streams.json"), "w") as f:
+        f.write("{torn")
+    _write_calib(root, "r10", 7.25)
+    assert config_mod.stream_overrides(
+        repo_root=root)["stream_threshold"] == 7.25
+
+
+def test_apply_streams_noop_when_off_or_explicit():
+    cfg = config_mod.Config(stream=False)
+    assert config_mod.apply_streams(cfg) is cfg
+    cfg = config_mod.Config(stream=True, stream_threshold=12.0)
+    assert config_mod.apply_streams(cfg) is cfg
+
+
+def test_committed_calibration_artifact_resolves():
+    """The repo's own committed artifact must satisfy the loader (the
+    acceptance evidence for the calibration workflow)."""
+    over = config_mod.stream_overrides()
+    assert isinstance(over["stream_threshold"], float)
+
+
+def test_session_fps_comes_from_delivery_clock():
+    """stats()['fps'] is the session's own delivered/elapsed — the
+    sanctioned stream-rate source for bench lines (no hand-rolled span
+    timing in chip-path scripts)."""
+    srv = _FakeServer()
+    sess = StreamSession(srv, (64, 64, 3), gate=False)
+    rng = np.random.default_rng(10)
+    try:
+        for _ in range(4):
+            sess.submit_frame(_frame(rng))
+        sess.drain(timeout=30)
+        time.sleep(0.01)
+        st = sess.stats()
+        assert st["delivered"] == 4
+        assert st["fps"] is not None and st["fps"] > 0
+    finally:
+        sess.close()
