@@ -20,7 +20,12 @@ one chip, steady-state:
   bit-identity check;
 * `donation_ok` — the graftlint trace-audit donation check over the timed
   train program (analysis/trace_audit.py): every chip run self-reports
-  buffer-aliasing health instead of hiding it in a chip-log warning.
+  buffer-aliasing health instead of hiding it in a chip-log warning;
+* `transfer_audit_ok` — the graftlint layer-4 budget check over the SAME
+  timed program (analysis/transfer_audit.py): fetched-leaf / fresh-input /
+  host-callback counts vs the committed transfer manifest's mode-matched
+  train entry (shape-independent, eval_shape only) — a chip number that
+  paid unbudgeted fetches says so on its own JSON line.
 
 Measurement methodology (round-2 postmortem): on the remote-tunnel `axon`
 backend, `block_until_ready` resolves BEFORE remote execution completes and
@@ -299,7 +304,10 @@ def find_last_tpu_result(repo_root: str | None = None) -> dict | None:
             "cascade", "escalation_rate",
             # stream fields (ISSUE 17): absent on pre-stream lines —
             # the consumer parses via bench_stream_of (stream-off)
-            "stream", "tile_skip_rate", "stream_fps")
+            "stream", "tile_skip_rate", "stream_fps",
+            # audit self-reports (ISSUE 19): a surfaced on-chip number
+            # keeps its hygiene verdicts attached
+            "donation_ok", "lock_audit_clean", "transfer_audit_ok")
     out.update({k: rec[k] for k in keep if k in rec})
     return out
 
@@ -906,6 +914,23 @@ def _bench(out: dict, hb) -> None:
                 lock_audit.audit_repo(_lroot), load_baseline())["new"]
         except Exception as e:  # noqa: BLE001 — never block the bench
             log("lock audit unavailable: %r" % e)
+        try:
+            # transfer_audit_ok: the D2H/H2D budget (graftlint layer 4)
+            # self-reported the same way — the TIMED program's fetched-
+            # leaf / fresh-input / host-callback counts vs the committed
+            # manifest's mode-matched train entry (shape-independent:
+            # the bench runs real archs while the manifest pins the tiny
+            # audit config; eval_shape only, no device work). False
+            # means the chip number paid fetches the budget never
+            # approved.
+            from real_time_helmet_detection_tpu.analysis.transfer_audit \
+                import bench_transfer_ok
+            out["transfer_audit_ok"] = bench_transfer_ok(
+                train_n, (state, *arrs), donate_argnums=(0,),
+                entry=("train_step_scanned[sentinel]" if sentinel_on
+                       else "train_step_scanned"))
+        except Exception as e:  # noqa: BLE001 — never block the bench
+            log("transfer audit unavailable: %r" % e)
         # warmup run consumes (donates) `state`; rebuild for the timed run.
         # The program returns (final state, last loss) so every donated
         # buffer has an output to alias (donation actually elides the

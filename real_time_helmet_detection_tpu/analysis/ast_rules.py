@@ -624,16 +624,20 @@ def rule_context_free_span(tree, lines, relpath) -> List[Finding]:
     return out
 
 
-def _references_fleet_router(tree: ast.Module) -> bool:
+def _references_name(tree: ast.Module, name: str) -> bool:
     for node in ast.walk(tree):
-        if isinstance(node, ast.Name) and node.id == "FleetRouter":
+        if isinstance(node, ast.Name) and node.id == name:
             return True
-        if isinstance(node, ast.Attribute) and node.attr == "FleetRouter":
+        if isinstance(node, ast.Attribute) and node.attr == name:
             return True
         if isinstance(node, (ast.Import, ast.ImportFrom)) \
-                and any(a.name == "FleetRouter" for a in node.names):
+                and any(a.name == name for a in node.names):
             return True
     return False
+
+
+def _references_fleet_router(tree: ast.Module) -> bool:
+    return _references_name(tree, "FleetRouter")
 
 
 def rule_engine_bypass_in_fleet(tree, lines, relpath) -> List[Finding]:
@@ -678,6 +682,80 @@ def rule_engine_bypass_in_fleet(tree, lines, relpath) -> List[Finding]:
                         "re-dispatch ack guarantee stop applying; go "
                         "through router.submit (or the allowlisted "
                         "factory/dispatch scopes)" % hit))
+    return out
+
+
+_THRESHOLD_KWARGS = {"threshold", "cascade_threshold", "stream_threshold",
+                     "skip_threshold"}
+_THRESHOLD_REFS = ("FleetRouter", "StreamSession")
+_THRESHOLD_FILES = {"scripts/serve_bench.py"}
+
+
+def _numeric_literal(node) -> bool:
+    """A bare numeric constant (possibly signed) — the hand-picked shape.
+    None, names, attribute reads and computed expressions all pass: the
+    sanctioned flows (cfg fields, calibrated-artifact lookups, values
+    derived from the data in hand) are never literals."""
+    if isinstance(node, ast.UnaryOp) \
+            and isinstance(node.op, (ast.USub, ast.UAdd)):
+        node = node.operand
+    return isinstance(node, ast.Constant) \
+        and isinstance(node.value, (int, float)) \
+        and not isinstance(node.value, bool)
+
+
+def rule_hand_picked_threshold(tree, lines, relpath) -> List[Finding]:
+    """A numeric-literal confidence/skip threshold reaching the serving
+    plane (ISSUE 19 satellite): the cascade escalation threshold and the
+    stream tile-skip threshold are CALIBRATED ARTIFACTS
+    (`quality_matrix --cascade/--streams` -> `config.cascade_overrides()`
+    / `stream_overrides()`), never constants — a hand-picked value either
+    over-escalates (goodput collapses to all-quality) or under-escalates
+    (blended mAP silently decays), and nothing re-checks it when the
+    model or data drifts. Scope: serving/ modules, scripts/serve_bench.py,
+    and any module referencing FleetRouter/StreamSession. Two signatures:
+    (a) a threshold-named kwarg bound to a numeric literal at any call
+    site, (b) an argparse `--*threshold` option with a numeric default
+    (None + explicit resolution is the sanctioned CLI shape)."""
+    in_scope = relpath.startswith(SERVING_PREFIX) \
+        or relpath in _THRESHOLD_FILES \
+        or any(_references_name(tree, n) for n in _THRESHOLD_REFS)
+    if not in_scope:
+        return []
+    out = []
+    for qual, node, body in _iter_scopes(tree):
+        for call in _scope_calls(body):
+            leaf = _call_name(call).split(".")[-1]
+            hits = []
+            if leaf == "add_argument":
+                opt = next((a.value for a in call.args
+                            if isinstance(a, ast.Constant)
+                            and isinstance(a.value, str)
+                            and "threshold" in a.value), None)
+                if opt is not None:
+                    hits += ["argparse option %s with a numeric default"
+                             % opt
+                             for kw in call.keywords
+                             if kw.arg == "default"
+                             and _numeric_literal(kw.value)]
+            else:
+                hits += ["%s=<literal> at a call site" % kw.arg
+                         for kw in call.keywords
+                         if kw.arg in _THRESHOLD_KWARGS
+                         and _numeric_literal(kw.value)]
+            for desc in hits:
+                if _suppressed("hand-picked-threshold", lines,
+                               call.lineno,
+                               getattr(call, "end_lineno", call.lineno)):
+                    continue
+                out.append(Finding(
+                    rule="ast/hand-picked-threshold", path=relpath,
+                    line=call.lineno, context=qual,
+                    message="hand-picked threshold (%s): confidence/skip "
+                            "thresholds are calibrated artifacts — "
+                            "resolve via config.cascade_overrides()/"
+                            "stream_overrides() (or derive from the data "
+                            "in hand), never a constant" % desc))
     return out
 
 
@@ -888,7 +966,8 @@ RULES = (rule_per_call_timing, rule_queue_bypass, rule_env_platform_write,
          rule_missing_ref_citation, rule_raw_span_timing,
          rule_device_get_in_serving_loop, rule_unbounded_retry,
          rule_raw_metric_aggregation, rule_unbarriered_collective_start,
-         rule_engine_bypass_in_fleet, rule_context_free_span)
+         rule_engine_bypass_in_fleet, rule_context_free_span,
+         rule_hand_picked_threshold)
 
 
 # ---------------------------------------------------------------------------

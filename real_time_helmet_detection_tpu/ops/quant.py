@@ -186,6 +186,38 @@ def make_quant_model(cfg, dtype=None, mode: str = "int8",
                        calib_percentile=calib_percentile)
 
 
+def make_calib_step(cfg, dtype=None, normalize: Optional[str] = None,
+                    percentile: float = 100.0):
+    """The un-jitted instrumented calibration step
+    `(params, batch_stats, images, agg) -> quant stats pytree`.
+
+    Exposed separately from `calibrate_scales` so the transfer audit
+    (analysis/transfer_audit.py) can measure the max-combine program's
+    device<->host surface abstractly: its whole output — the per-layer
+    scalar pytree — IS the calibration pass's single D2H budget.
+    """
+    cmodel = make_quant_model(cfg, dtype=dtype, mode="calibrate",
+                              calib_percentile=percentile)
+    if normalize is not None:
+        from ..utils import normalizer_stats
+        mean, std = (jnp.asarray(s) for s in normalizer_stats(normalize))
+
+    def calib_step(params, batch_stats, images, agg):
+        if normalize is not None:
+            images = (images.astype(jnp.float32) / 255.0 - mean) / std
+        folded = fold_batchnorm(params, batch_stats)
+        _, mut = cmodel.apply({"params": folded}, images, train=False,
+                              mutable=["quant"])
+        stats = mut["quant"]
+        # agg=None is a static (empty-pytree) arg: the first batch traces
+        # its own program, every later batch hits the max-combine trace
+        if agg is None:
+            return stats
+        return jax.tree.map(jnp.maximum, agg, stats)
+
+    return calib_step
+
+
 def calibrate_scales(cfg, variables, batches: Iterable,
                      dtype=None, normalize: Optional[str] = None,
                      percentile: float = 100.0) -> Dict:
@@ -203,26 +235,9 @@ def calibrate_scales(cfg, variables, batches: Iterable,
     (outlier-robust); the running reduce still max-combines the
     per-batch percentiles (conservative).
     """
-    cmodel = make_quant_model(cfg, dtype=dtype, mode="calibrate",
-                              calib_percentile=percentile)
-    if normalize is not None:
-        from ..utils import normalizer_stats
-        mean, std = (jnp.asarray(s) for s in normalizer_stats(normalize))
-
-    @jax.jit
-    def calib_step(params, batch_stats, images, agg):
-        if normalize is not None:
-            images = (images.astype(jnp.float32) / 255.0 - mean) / std
-        folded = fold_batchnorm(params, batch_stats)
-        _, mut = cmodel.apply({"params": folded}, images, train=False,
-                              mutable=["quant"])
-        stats = mut["quant"]
-        # agg=None is a static (empty-pytree) arg: the first batch traces
-        # its own program, every later batch hits the max-combine trace
-        if agg is None:
-            return stats
-        return jax.tree.map(jnp.maximum, agg, stats)
-
+    calib_step = jax.jit(make_calib_step(cfg, dtype=dtype,
+                                         normalize=normalize,
+                                         percentile=percentile))
     agg = None
     for images in batches:
         agg = calib_step(variables["params"], variables["batch_stats"],

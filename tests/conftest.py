@@ -39,3 +39,26 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402  (after the platform pin on purpose)
+
+
+@pytest.fixture
+def count_device_get():
+    """The ONE `jax.device_get`-counting implementation behind every
+    per-subsystem zero-extra-D2H pin (ISSUE 19 satellite) — backed by
+    the transfer audit's runtime twin so the static manifest
+    (analysis/transfer_manifest.json) and the dynamic pins share one
+    definition of "a fetch". Usage::
+
+        def test_x(count_device_get):
+            with count_device_get() as c:
+                ...  # run the loop under test
+            assert c.count == n_expected   # c.calls keeps the trees
+
+    The context restores the real `jax.device_get` on exit (even when
+    the body raises), so a single test can open several independent
+    counting windows."""
+    from real_time_helmet_detection_tpu.analysis.transfer_audit import \
+        counting_device_get
+    return counting_device_get

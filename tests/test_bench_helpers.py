@@ -549,3 +549,28 @@ def test_find_last_tpu_result_old_lines_lack_stream_keys(tmp_path):
     assert "stream" not in got and "stream_fps" not in got
     assert bench.bench_stream_of(got) == {
         "stream": False, "tile_skip_rate": None, "stream_fps": None}
+
+
+def test_find_last_tpu_result_carries_audit_fields(tmp_path):
+    """ISSUE 19 satellite: the hygiene self-reports (donation_ok,
+    lock_audit_clean, transfer_audit_ok) ride find_last_tpu_result so a
+    surfaced on-chip number keeps its audit verdicts attached; old lines
+    without the keys are unaffected."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r19", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1250.0,
+        "mfu_train": 0.61, "donation_ok": True, "lock_audit_clean": True,
+        "transfer_audit_ok": True})
+    got = bench.find_last_tpu_result(root)
+    assert got["donation_ok"] is True
+    assert got["lock_audit_clean"] is True
+    assert got["transfer_audit_ok"] is True
+    assert got["value"] == 1250.0 and got["mfu_train"] == 0.61
+
+
+def test_find_last_tpu_result_old_lines_lack_audit_keys(tmp_path):
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r18", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1100.0})
+    got = bench.find_last_tpu_result(root)
+    assert "transfer_audit_ok" not in got and "donation_ok" not in got

@@ -181,10 +181,13 @@ def test_find_last_tpu_result_carries_cascade_fields(tmp_path):
         "cascade": True, "escalation_rate": 0.031}
 
 
-def test_predict_cascade_summary_only_adds_a_leaf():
+def test_predict_cascade_summary_only_adds_a_leaf(count_device_get):
     """cascade_summary=True returns CascadeDetections whose det leaves
     are bit-identical to the plain program's (the cascade-off program is
-    untouched; the summary only ADDS the scalar)."""
+    untouched; the summary only ADDS the scalar), and the summary RIDES
+    the one box-block fetch — the device_get count is identical to the
+    plain program's (the zero-extra-D2H law, pinned by the shared
+    conftest counter exactly like the telemetry/sentinel contracts)."""
     from real_time_helmet_detection_tpu.models import build_model
     from real_time_helmet_detection_tpu.predict import make_predict_fn
     from real_time_helmet_detection_tpu.train import init_variables
@@ -196,9 +199,13 @@ def test_predict_cascade_summary_only_adds_a_leaf():
                                              ).astype(np.float32))
     params, batch_stats = init_variables(model, jax.random.key(0), 64)
     variables = {"params": params, "batch_stats": batch_stats}
-    plain = jax.device_get(make_predict_fn(model, cfg)(variables, images))
-    casc = jax.device_get(make_predict_fn(
-        model, cfg, cascade_summary=True)(variables, images))
+    with count_device_get() as c_plain:
+        plain = jax.device_get(
+            make_predict_fn(model, cfg)(variables, images))
+    with count_device_get() as c_casc:
+        casc = jax.device_get(make_predict_fn(
+            model, cfg, cascade_summary=True)(variables, images))
+    assert c_plain.count == c_casc.count == 1  # ONE fetch, summary rides it
     assert isinstance(casc, CascadeDetections)
     for name in ("boxes", "classes", "scores", "valid"):
         assert np.array_equal(getattr(plain, name), getattr(casc, name))

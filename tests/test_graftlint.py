@@ -568,3 +568,69 @@ def test_serve_bucket_entries_audit_clean():
             lambda v, im: predict(v, im), (variables, images),
             "serve_predict[b=%d]" % b, lower=False)
         assert not findings, [f.message for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: hand-picked-threshold rule + xfer findings through the CLI
+
+
+def test_hand_picked_threshold_scope_and_sanctioned_shapes():
+    """ast/hand-picked-threshold: a numeric-literal threshold kwarg fires
+    in serving scope (path, serve_bench.py, or a FleetRouter/StreamSession
+    reference); the calibrated-artifact resolution and a None argparse
+    default are the sanctioned shapes."""
+    bad = ("def route(router, img):\n"
+           "    return router.submit(img, cascade_threshold=0.25)\n")
+    good = ("def route(router, img, cfg):\n"
+            "    th = cfg.cascade_overrides()['threshold']\n"
+            "    return router.submit(img, cascade_threshold=th)\n")
+    spath = ast_rules.SERVING_PREFIX + "x.py"
+    assert "ast/hand-picked-threshold" in rules_of(
+        ast_rules.lint_source(bad, spath))
+    assert "ast/hand-picked-threshold" not in rules_of(
+        ast_rules.lint_source(good, spath))
+    # serve_bench.py is in scope by path; an unrelated script is not
+    assert "ast/hand-picked-threshold" in rules_of(
+        ast_rules.lint_source(bad, "scripts/serve_bench.py"))
+    assert "ast/hand-picked-threshold" not in rules_of(
+        ast_rules.lint_source(bad, "scripts/x.py"))
+    # ...unless it references the serving classes
+    assert "ast/hand-picked-threshold" in rules_of(ast_rules.lint_source(
+        "from real_time_helmet_detection_tpu.serving import StreamSession\n"
+        + bad, "scripts/x.py"))
+    # argparse: a numeric default on a --*threshold option fires; None +
+    # downstream resolution is the sanctioned CLI shape
+    argp = ("def cli(p):\n"
+            "    p.add_argument('--stream-threshold', type=float,"
+            " default=%s)\n")
+    assert "ast/hand-picked-threshold" in rules_of(ast_rules.lint_source(
+        argp % "1.0", "scripts/serve_bench.py"))
+    assert "ast/hand-picked-threshold" not in rules_of(
+        ast_rules.lint_source(argp % "None", "scripts/serve_bench.py"))
+
+
+def test_xfer_findings_render_as_github_annotations():
+    """A manifest delta (no source line of its own) anchors its ::error
+    annotation to the committed manifest file, so `--format github` CI
+    runs show budget regressions inline like any other finding."""
+    import importlib.util
+    from real_time_helmet_detection_tpu.analysis import transfer_audit as xa
+    spec = importlib.util.spec_from_file_location(
+        "graftlint_mod", os.path.join(REPO, "scripts", "graftlint.py"))
+    gl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gl)
+    entry = {"d2h": {"leaves": 1, "bytes": 8, "shapes": ["float32[]"]},
+             "h2d_fresh": {"leaves": 1, "bytes": 4},
+             "donated": {"leaves": 1, "bytes": 400},
+             "host_callbacks": 0}
+    grown = json.loads(json.dumps(entry))
+    grown["d2h"]["leaves"] = 2
+    grown["d2h"]["shapes"] = ["float32[]", "float32[]"]
+    res = xa.gate_manifest({"e": grown},
+                           {"schema": xa.SCHEMA, "entries": {"e": entry}})
+    assert rules_of(res["findings"]) == {"xfer/extra-fetch-leaf"}
+    lines = gl.github_annotations(res["findings"])
+    assert len(lines) == 1
+    assert lines[0].startswith(
+        "::error file=%s,line=1,title=xfer/extra-fetch-leaf"
+        % xa.MANIFEST_RELPATH)

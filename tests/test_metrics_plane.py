@@ -287,36 +287,30 @@ def parts():
     return predict, variables, pool
 
 
-def _run_stream(predict, variables, pool, monkeypatch, export_path):
+def _run_stream(predict, variables, pool, monkeypatch, count_device_get,
+                export_path):
     """One deterministic request stream; returns (device_get count,
     detection bytes, final stats)."""
     if export_path:
         monkeypatch.setenv("OBS_METRICS", export_path)
     else:
         monkeypatch.delenv("OBS_METRICS", raising=False)
-    calls = []
-    real_get = jax.device_get
-
-    def counting(tree):
-        calls.append(tree)
-        return real_get(tree)
-
-    monkeypatch.setattr(jax, "device_get", counting)
-    eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3), np.uint8,
-                        buckets=(1, 2), max_wait_ms=0.0, depth=1,
-                        queue_capacity=16, metrics=MetricsRegistry())
-    rows = []
-    for i in range(6):
-        rows.append(eng.submit(pool[i % len(pool)]).result(timeout=30))
-    eng.close()
-    n = len(calls)
-    monkeypatch.undo()
+    with count_device_get() as counter:
+        eng = ServingEngine(predict, variables, (IMSIZE, IMSIZE, 3),
+                            np.uint8, buckets=(1, 2), max_wait_ms=0.0,
+                            depth=1, queue_capacity=16,
+                            metrics=MetricsRegistry())
+        rows = []
+        for i in range(6):
+            rows.append(eng.submit(pool[i % len(pool)]).result(timeout=30))
+        eng.close()
     blob = b"".join(np.asarray(r.boxes).tobytes() + np.asarray(
         r.scores).tobytes() for r in rows)
-    return n, blob, eng.stats()
+    return counter.count, blob, eng.stats()
 
 
-def test_metrics_off_same_fetches_and_bits(parts, monkeypatch, tmp_path):
+def test_metrics_off_same_fetches_and_bits(parts, monkeypatch, tmp_path,
+                                           count_device_get):
     """Acceptance: $OBS_METRICS unset runs the exact same programs — the
     engine performs the SAME number of device_get calls and returns
     bit-identical detections as with export armed (the metrics plane is
@@ -325,9 +319,11 @@ def test_metrics_off_same_fetches_and_bits(parts, monkeypatch, tmp_path):
     predict, variables, pool = parts
     export = str(tmp_path / "metrics.jsonl")
     n_on, blob_on, st_on = _run_stream(predict, variables, pool,
-                                       monkeypatch, export)
+                                       monkeypatch, count_device_get,
+                                       export)
     n_off, blob_off, st_off = _run_stream(predict, variables, pool,
-                                          monkeypatch, None)
+                                          monkeypatch, count_device_get,
+                                          None)
     assert n_on == n_off            # zero extra D2H fetches
     assert blob_on == blob_off      # bit-identical results
     assert st_on["completed"] == st_off["completed"] == 6
@@ -393,8 +389,8 @@ def test_engine_degrade_api_recovers_after_healthy_batches(parts):
 # train_epoch count-pin: metrics/SLO ride the existing flush
 
 
-def test_train_epoch_metrics_do_not_change_fetch_count(monkeypatch,
-                                                       tmp_path):
+def test_train_epoch_metrics_do_not_change_fetch_count(
+        count_device_get, tmp_path):
     """The loop-level acceptance twin: train_epoch with the metrics
     writer + SLO watchdog armed performs EXACTLY the same device_get
     calls (the deferred flush barrier) as with both absent, and logs
@@ -426,20 +422,11 @@ def test_train_epoch_metrics_do_not_change_fetch_count(monkeypatch,
         return state + 1, {"hm": v, "offset": v, "size": v, "total": v}
 
     def run(mwriter, slo):
-        calls = []
-        real_get = jax.device_get
-
-        def counting(tree):
-            calls.append(tree)
-            return real_get(tree)
-
-        monkeypatch.setattr(jax, "device_get", counting)
         loss_log = LossLog()
-        train_epoch(cfg, 0, FakeLoader(5), runner, 0, None, loss_log,
-                    is_chief=True, mwriter=mwriter, slo=slo)
-        n = len(calls)
-        monkeypatch.undo()
-        return n, loss_log.log["total"]
+        with count_device_get() as counter:
+            train_epoch(cfg, 0, FakeLoader(5), runner, 0, None, loss_log,
+                        is_chief=True, mwriter=mwriter, slo=slo)
+        return counter.count, loss_log.log["total"]
 
     export = str(tmp_path / "metrics.jsonl")
     reg = default_registry()

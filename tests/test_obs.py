@@ -270,23 +270,11 @@ def test_ring_empty_decodes_empty():
 # in-jit step telemetry: the single-fetch contract + off == pre-PR
 
 
-def _counting_device_get(monkeypatch):
-    calls = []
-    real_get = jax.device_get
-
-    def counting(tree):
-        calls.append(tree)
-        return real_get(tree)
-
-    monkeypatch.setattr(jax, "device_get", counting)
-    return calls
-
-
 @pytest.mark.slow  # 145 s at r15 --durations: the heaviest smoke-tier
 # compile (telemetry ring + scan); the D2H-count pin is a perf-hygiene
 # check, not a robustness acceptance test — re-tiered to fit the 870 s
 # tier-1 budget (ISSUE 13 satellite)
-def test_scanned_telemetry_one_d2h_per_outer_loop(monkeypatch):
+def test_scanned_telemetry_one_d2h_per_outer_loop(count_device_get):
     """Acceptance: telemetry-on, the bench-style outer loop performs
     exactly one D2H fetch per iteration — the SAME count as telemetry-off
     — and the ring rides that fetch as a fixed-size payload."""
@@ -302,14 +290,12 @@ def test_scanned_telemetry_one_d2h_per_outer_loop(monkeypatch):
         compiled = jax.jit(train_n, donate_argnums=(0,)).lower(
             state0, *arrs).compile()
         state = jax.tree.map(lambda x: jnp.array(np.asarray(x)), state0)
-        calls = _counting_device_get(monkeypatch)
         fetched = []
-        for _ in range(n_outer):
-            state, out = compiled(state, *arrs)  # async dispatch
-            fetched.append(jax.device_get(out))  # THE one D2H
-        n_fetches = len(calls)
-        monkeypatch.undo()
-        return n_fetches, fetched
+        with count_device_get() as counter:
+            for _ in range(n_outer):
+                state, out = compiled(state, *arrs)  # async dispatch
+                fetched.append(jax.device_get(out))  # THE one D2H
+        return counter.count, fetched
 
     on_fetches, on_host = run_loop(cfg_on, telemetry=True)
     off_fetches, off_host = run_loop(tiny_cfg(), telemetry=False)

@@ -128,7 +128,7 @@ def test_sentinel_off_bit_identical_to_pre_pr():
         assert np.array_equal(np.asarray(x), np.asarray(y))
 
 
-def test_sentinel_zero_extra_d2h(monkeypatch):
+def test_sentinel_zero_extra_d2h(count_device_get):
     """Acceptance: the sentinel scalars ride the SAME deferred flush —
     the train_epoch-style loop performs exactly as many device_get calls
     with the sentinel on as off, and the monitor consumes already-host
@@ -143,25 +143,17 @@ def test_sentinel_zero_extra_d2h(monkeypatch):
         step = make_train_step(model, tx, cfg, mesh)
         batch = shard_batch(mesh, synthetic_batch(), spatial_dims=[1] * 5)
         monitor = SentinelMonitor(cfg) if cfg.sentinel else None
-        calls = []
-        real_get = jax.device_get
-
-        def counting(tree):
-            calls.append(tree)
-            return real_get(tree)
-
-        monkeypatch.setattr(jax, "device_get", counting)
-        pending = []
-        for _ in range(n_steps):
-            args = (np.float32(monitor.scale_value()),) if monitor else ()
-            state, losses = step(state, *batch, *args)
-            pending.append(losses)
-        fetched = jax.device_get(pending)  # THE one flush D2H
-        if monitor is not None:
-            monitor.observe(fetched)
-        n = len(calls)
-        monkeypatch.undo()
-        return n, monitor
+        with count_device_get() as counter:
+            pending = []
+            for _ in range(n_steps):
+                args = ((np.float32(monitor.scale_value()),)
+                        if monitor else ())
+                state, losses = step(state, *batch, *args)
+                pending.append(losses)
+            fetched = jax.device_get(pending)  # THE one flush D2H
+            if monitor is not None:
+                monitor.observe(fetched)
+        return counter.count, monitor
 
     on_calls, monitor = run_loop(tiny_cfg(sentinel=True))
     off_calls, _ = run_loop(tiny_cfg())

@@ -187,6 +187,28 @@ def test_first_frame_computes_all_then_static_skips():
         sess.close()
 
 
+def test_session_adds_no_device_get_of_its_own(count_device_get):
+    """The zero-extra-D2H pin for the stream plane, on the shared
+    conftest counter: StreamSession performs ZERO `jax.device_get` calls
+    of its own — the per-frame delta rides the ONE tiny (T,) `np.asarray`
+    fetch (budgeted as stream_delta_summary in
+    analysis/transfer_manifest.json) and every detection fetch belongs
+    to the engine's batched D2H. A session-side `device_get` (e.g. a
+    debug fetch of the whole frame tree) trips this pin."""
+    srv = _FakeServer()
+    sess = StreamSession(srv, (64, 64, 3), grid=2, threshold=1.0,
+                         ema=0.0)
+    rng = np.random.default_rng(11)
+    try:
+        with count_device_get() as counter:
+            r0 = sess.submit_frame(_frame(rng)).result(timeout=30)
+            r1 = sess.submit_frame(_frame(rng)).result(timeout=30)
+        assert r0.total_tiles == r1.total_tiles == 4
+        assert counter.count == 0
+    finally:
+        sess.close()
+
+
 def test_all_changed_frame_reassembles_to_the_tile_oracle():
     """Every tile changed: the frame answer IS stitch_detections of the
     per-tile answers at the tile origins (ema=0 isolates reassembly)."""

@@ -406,7 +406,7 @@ def real_parts():
 
 
 def test_tracing_off_bit_identity_and_device_get_count(tmp_path,
-                                                       monkeypatch,
+                                                       count_device_get,
                                                        real_parts):
     """The acceptance pin: tracing ON vs OFF over the REAL predict —
     results byte-identical, and the number of jax.device_get calls (the
@@ -416,26 +416,18 @@ def test_tracing_off_bit_identity_and_device_get_count(tmp_path,
     pool = _pool(4, imsize=REAL_IMSIZE)
 
     def run(tracer):
-        calls = []
-        real_get = jax.device_get
-
-        def counting(x):
-            calls.append(1)
-            return real_get(x)
-
         eng = ServingEngine(predict, variables,
                             (REAL_IMSIZE, REAL_IMSIZE, 3),
                             np.uint8, buckets=(1, 2, 4), max_wait_ms=5.0,
                             queue_capacity=16,
                             metrics=MetricsRegistry(), tracer=tracer,
                             start=False)
-        monkeypatch.setattr(jax, "device_get", counting)
-        futs = [eng.submit(img) for img in pool]  # one bucket-4 batch
-        eng.start()
-        rows = [f.result(timeout=60) for f in futs]
-        eng.close()
-        monkeypatch.undo()
-        return calls, rows
+        with count_device_get() as counter:
+            futs = [eng.submit(img) for img in pool]  # one bucket-4 batch
+            eng.start()
+            rows = [f.result(timeout=60) for f in futs]
+            eng.close()
+        return counter.calls, rows
 
     off_calls, off_rows = run(SpanTracer(None))  # disabled tracer
     on_path = str(tmp_path / "spans.jsonl")

@@ -1,0 +1,314 @@
+"""Transfer-budget audit (graftlint layer 4) acceptance tests.
+
+The three regression classes the layer exists to catch — an extra
+fetched leaf, a newly un-donated input, D2H byte growth past the 2%
+tolerance — each FAIL against a committed manifest, while a sub-tolerance
+wiggle passes; the committed manifest itself covers the registered jitted
+surfaces and gates clean at HEAD. Measurement is `jax.eval_shape` +
+`jax.make_jaxpr` only, so everything here is milliseconds on CPU except
+the explicitly slow full-repo sweep.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_helmet_detection_tpu.analysis import transfer_audit as xa
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# measure_entry: the donation-aware fetch surface
+
+
+def _state():
+    return np.zeros((100,), np.float32)
+
+
+def _batch():
+    return np.zeros((50,), np.float32)
+
+
+def _base(s, b):
+    return s + 1.0, jnp.sum(b)
+
+
+def test_donated_alias_is_not_a_fetch():
+    """The scanned-train-step shape: the full state aliases into the
+    donated input, so the fetch surface is the loss scalar alone."""
+    m = xa.measure_entry(_base, (_state(), _batch()), donate_argnums=(0,))
+    assert m["d2h"]["leaves"] == 1
+    assert m["d2h"]["bytes"] == 4
+    assert m["d2h"]["shapes"] == ["float32[]"]
+    assert m["donated"]["leaves"] == 1
+    assert m["h2d_fresh"]["leaves"] == 1
+    assert m["h2d_fresh"]["bytes"] == 200
+    assert m["host_callbacks"] == 0
+
+
+def test_without_donation_every_output_is_a_fetch():
+    m = xa.measure_entry(_base, (_state(), _batch()))
+    assert m["d2h"]["leaves"] == 2          # state round-trips over D2H
+    assert m["d2h"]["bytes"] == 404
+    assert m["donated"]["leaves"] == 0
+    assert m["h2d_fresh"]["leaves"] == 2
+
+
+def test_host_callback_counted():
+    def with_cb(s, b):
+        jax.debug.print("loss={l}", l=jnp.sum(b))
+        return s + 1.0, jnp.sum(b)
+
+    m = xa.measure_entry(with_cb, (_state(), _batch()),
+                         donate_argnums=(0,))
+    assert m["host_callbacks"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# gate_manifest: the ratchet
+
+
+def _manifest_for(measured):
+    return {"schema": xa.SCHEMA, "entries": dict(measured)}
+
+
+def _gate(fn, donate=(0,), budget_fn=_base, budget_donate=(0,)):
+    budget = {"e": xa.measure_entry(budget_fn, (_state(), _batch()),
+                                    donate_argnums=budget_donate)}
+    measured = {"e": xa.measure_entry(fn, (_state(), _batch()),
+                                      donate_argnums=donate)}
+    return xa.gate_manifest(measured, _manifest_for(budget))
+
+
+def _rules(res):
+    return {f.rule for f in res["findings"]}
+
+
+def test_identical_program_gates_clean():
+    res = _gate(_base)
+    assert not res["findings"] and not res["improved"]
+
+
+def test_extra_fetch_leaf_fails():
+    def extra(s, b):
+        return s + 1.0, (jnp.sum(b), jnp.max(b))  # a second scalar leaf
+
+    assert "xfer/extra-fetch-leaf" in _rules(_gate(extra))
+
+
+def test_undonated_input_fails():
+    # the same program with donation dropped: state becomes a fresh
+    # per-call upload AND a fetched output
+    res = _gate(_base, donate=())
+    assert "xfer/undonated-input" in _rules(res)
+    assert "xfer/extra-fetch-leaf" in _rules(res)
+
+
+def test_d2h_byte_growth_past_tolerance_fails():
+    def grown(s, b):
+        return s + 1.0, jnp.concatenate([b, b[:10]]) * 2.0  # +20% payload
+
+    def budget(s, b):
+        return s + 1.0, b * 2.0
+
+    assert "xfer/d2h-bytes-grew" in _rules(
+        _gate(grown, budget_fn=budget))
+
+
+def test_sub_tolerance_wiggle_passes():
+    # 404 -> 408 bytes: within the 2% byte tolerance, leaf count equal
+    def wiggle(s, b):
+        return s + 1.0, jnp.concatenate([jnp.sum(b)[None], b[:1]])
+
+    def budget(s, b):
+        return s + 1.0, jnp.sum(b)[None]
+
+    res = xa.gate_manifest(
+        {"e": xa.measure_entry(wiggle, (_state(), _batch()),
+                               donate_argnums=(0,))},
+        _manifest_for({"e": {
+            "d2h": {"leaves": 1, "bytes": 8, "shapes": ["float32[2]"]},
+            "h2d_fresh": {"leaves": 1, "bytes": 200},
+            "donated": {"leaves": 1, "bytes": 400},
+            "host_callbacks": 0}}))
+    assert not res["findings"]
+
+
+def test_host_callback_growth_fails():
+    def with_cb(s, b):
+        jax.debug.print("x={x}", x=jnp.sum(b))
+        return s + 1.0, jnp.sum(b)
+
+    assert "xfer/host-callback-grew" in _rules(_gate(with_cb))
+
+
+def test_unknown_entry_and_unmeasurable_fail():
+    measured = {"new-surface": xa.measure_entry(
+        _base, (_state(), _batch()), donate_argnums=(0,)),
+        "broken": {"error": "TypeError: boom"}}
+    rules = _rules(xa.gate_manifest(measured, _manifest_for({})))
+    assert rules == {"xfer/unknown-entry", "xfer/entry-unmeasurable"}
+
+
+def test_improvement_reported_not_failed():
+    def leaner(s, b):
+        return (s + 1.0,)  # dropped the loss fetch entirely
+
+    res = _gate(leaner)
+    assert not res["findings"]
+    assert any("d2h leaves" in msg for msg in res["improved"])
+
+
+def test_stale_only_judged_on_full_runs():
+    budget = {"gone": {"d2h": {"leaves": 1, "bytes": 4, "shapes": []},
+                       "h2d_fresh": {"leaves": 0, "bytes": 0},
+                       "donated": {"leaves": 0, "bytes": 0},
+                       "host_callbacks": 0}}
+    # partial (--changed-style) measurement: staleness is unjudgeable
+    res = xa.gate_manifest({}, _manifest_for(budget))
+    assert res["stale"] == []
+
+
+def test_write_manifest_refuses_unmeasurable(tmp_path):
+    with pytest.raises(ValueError):
+        xa.write_manifest({"e": {"error": "boom"}},
+                          str(tmp_path / "m.json"))
+
+
+def test_manifest_schema_enforced(tmp_path):
+    p = tmp_path / "m.json"
+    p.write_text(json.dumps({"schema": "something-else", "entries": {}}))
+    with pytest.raises(ValueError):
+        xa.load_manifest(str(p))
+
+
+def test_missing_manifest_fails_as_unknown_entries(tmp_path):
+    mf = xa.load_manifest(str(tmp_path / "absent.json"))
+    measured = {"e": xa.measure_entry(_base, (_state(), _batch()),
+                                      donate_argnums=(0,))}
+    assert _rules(xa.gate_manifest(measured, mf)) == {"xfer/unknown-entry"}
+
+
+# ---------------------------------------------------------------------------
+# the committed manifest: coverage + a cheap HEAD gate
+
+
+def test_committed_manifest_covers_the_registered_surfaces():
+    """The acceptance floor: >=10 budgeted entry points including the
+    train telemetry/sentinel modes, the cascade summary, the stream
+    delta summary, and at least two serve buckets — and the registry and
+    the committed file agree exactly."""
+    mf = xa.load_manifest()
+    entries = mf["entries"]
+    assert len(entries) >= 10
+    for required in ("train_step_scanned",
+                     "train_step_scanned[telemetry]",
+                     "train_step_scanned[sentinel]",
+                     "predict_cascade_summary[tier=edge]",
+                     "stream_delta_summary[grid=2]",
+                     "serve_predict[b=1]", "serve_predict[b=4]",
+                     "calibrate_scales"):
+        assert required in entries, required
+    assert set(entries) == set(xa.ENTRY_POINTS)
+    for name, e in entries.items():
+        assert "error" not in e, name
+        assert e["d2h"]["leaves"] >= 1, name
+
+
+def test_zero_extra_d2h_budgets_hold_in_the_manifest():
+    """The subsystem laws, as committed numbers: telemetry rides the one
+    fetch as (loss, ring buf, cursor); the sentinel adds ONE scalar; the
+    cascade summary adds ONE (B,) leaf over plain predict; nothing
+    budgets a host callback."""
+    e = xa.load_manifest()["entries"]
+    assert e["train_step_scanned"]["d2h"]["leaves"] == 1
+    assert e["train_step_scanned[sentinel]"]["d2h"]["leaves"] == 2
+    assert e["train_step_scanned[telemetry]"]["d2h"]["leaves"] == 3
+    assert (e["predict_cascade_summary[tier=edge]"]["d2h"]["leaves"]
+            == e["predict"]["d2h"]["leaves"] + 1)
+    assert e["stream_delta_summary[grid=2]"]["d2h"]["leaves"] == 1
+    assert all(v["host_callbacks"] == 0 for v in e.values())
+
+
+def test_changed_file_mapping_selects_owning_entries():
+    # a narrowly-owned module maps to exactly its entry
+    got = xa.entries_for_changed(
+        ["real_time_helmet_detection_tpu/obs/telemetry.py"])
+    assert got == {"train_step_scanned[telemetry]"}
+    # the engine is owned by the serve/tile surfaces, not bare predict
+    got = xa.entries_for_changed(
+        ["real_time_helmet_detection_tpu/serving/engine.py"])
+    assert {"serve_predict[b=1]", "serve_predict[b=2]",
+            "serve_predict[b=4]", "stream_tile_predict[b=2]"} == got
+    # a broad prefix (ops/) fans out to every entry that traces through it
+    got = xa.entries_for_changed(
+        ["real_time_helmet_detection_tpu/ops/delta.py"])
+    assert "stream_delta_summary[grid=2]" in got
+    assert "train_step_scanned" in got
+    assert xa.entries_for_changed(["docs/ARCHITECTURE.md"]) == set()
+
+
+@pytest.mark.slow  # full measurement sweep: one tiny compile per entry
+def test_repo_gates_clean_against_committed_manifest():
+    """HEAD's actual transfer surfaces match the committed budgets —
+    the same check `graftlint` runs as layer 4."""
+    res = xa.audit_transfers()
+    assert not res["findings"], [f.message for f in res["findings"]]
+    assert not res["stale"]
+
+
+@pytest.mark.slow  # one tiny train-step measurement
+def test_bench_transfer_ok_mode_matched():
+    fn, args, donate = xa._train_parts()
+    assert xa.bench_transfer_ok(fn, args, donate_argnums=donate,
+                                entry="train_step_scanned")
+    with pytest.raises(KeyError):
+        xa.bench_transfer_ok(fn, args, donate_argnums=donate,
+                             entry="no-such-entry")
+
+
+def test_bench_transfer_ok_flags_extra_fetch(tmp_path):
+    p = str(tmp_path / "m.json")
+    xa.write_manifest({"e": xa.measure_entry(
+        _base, (_state(), _batch()), donate_argnums=(0,))}, p)
+
+    def extra(s, b):
+        return s + 1.0, (jnp.sum(b), jnp.max(b))
+
+    assert xa.bench_transfer_ok(_base, (_state(), _batch()),
+                                donate_argnums=(0,), entry="e",
+                                manifest_path=p)
+    assert not xa.bench_transfer_ok(extra, (_state(), _batch()),
+                                    donate_argnums=(0,), entry="e",
+                                    manifest_path=p)
+
+
+# ---------------------------------------------------------------------------
+# the runtime twin behind the shared conftest fixture
+
+
+def test_counting_device_get_counts_and_restores():
+    real = jax.device_get
+    with xa.counting_device_get() as c:
+        jax.device_get(jnp.ones((2,)))
+        jax.device_get(jnp.zeros((3,)))
+        assert c.count == 2
+        assert len(c.calls) == 2
+    assert jax.device_get is real
+
+
+def test_counting_device_get_restores_on_raise():
+    real = jax.device_get
+    with pytest.raises(RuntimeError):
+        with xa.counting_device_get():
+            raise RuntimeError("boom")
+    assert jax.device_get is real
+
+
+def test_conftest_fixture_is_the_audit_hook(count_device_get):
+    assert count_device_get is xa.counting_device_get
