@@ -84,12 +84,13 @@ DEFAULT_HBM = 819e9  # v5e
 # and runner_drive.py (they diverged in r5: mfu_breakdown defaulted to r05
 # while the rest stayed at r04, scattering same-round artifacts — ADVICE
 # r5 #3); bump it here when a new round starts, or override per-run with
-# $GRAFT_ROUND. r17 = the streaming-video round (ISSUE 17: per-stream
-# delta-gated tile inference — serving/streams.py sessions over the
-# fleet, quality_matrix --streams skip-threshold calibration +
-# serve_bench --streams goodput evidence); earlier rounds' artifact dirs
-# are committed history and must not be overwritten.
-GRAFT_ROUND_DEFAULT = "r17"
+# $GRAFT_ROUND. r18 = the step-compression round (ISSUE 20: fused
+# residual-block pass — ops/pallas/residual.py's one-pass BN+add+Mish
+# with analytic backward, --block-fuse selection — plus --fwd-dtype int8
+# STE training; roofline --diff byte evidence + tpu_sweep block-fuse ×
+# fwd-dtype A/B twins); earlier rounds' artifact dirs are committed
+# history and must not be overwritten.
+GRAFT_ROUND_DEFAULT = "r18"
 
 # The arch fields every bench line carries (ISSUE 13): the residual-block
 # variant, stack count, width and the resolved tier name. Pre-tier lines
@@ -137,6 +138,23 @@ def bench_stream_of(rec: dict) -> dict:
     pre-stream lines parse as stream-off (regression-tested like the
     tier/cascade fields)."""
     return {k: rec.get(k, v) for k, v in STREAM_DEFAULTS.items()}
+
+
+# The step-compression fields (ISSUE 20): which residual-block tail the
+# benched train step ran (xla = the unfused BN→add→act chain, fused =
+# ops/pallas/residual.py's one-pass custom_vjp) and the forward compute
+# dtype (--fwd-dtype: bf16, or int8 STE training). Pre-ISSUE-20 lines
+# lack them — `bench_block_fuse_of` parses ANY line into the full dict,
+# defaulting to the historical unfused bf16 step (same back-compat
+# contract as bench_arch_of / bench_cascade_of / bench_stream_of).
+STEP_FUSE_DEFAULTS = {"block_fuse": "xla", "fwd_dtype": "bf16"}
+
+
+def bench_block_fuse_of(rec: dict) -> dict:
+    """The (block_fuse, fwd_dtype) of a bench JSON line; pre-ISSUE-20
+    lines parse as the unfused bf16 step (regression-tested like the
+    tier/cascade/stream fields)."""
+    return {k: rec.get(k, v) for k, v in STEP_FUSE_DEFAULTS.items()}
 
 # v5e int8 MXU peak (2x the bf16 peak — jax-ml scaling-book): the
 # denominator for int8-path MFU and the hardware case for --infer-dtype
@@ -305,6 +323,9 @@ def find_last_tpu_result(repo_root: str | None = None) -> dict | None:
             # stream fields (ISSUE 17): absent on pre-stream lines —
             # the consumer parses via bench_stream_of (stream-off)
             "stream", "tile_skip_rate", "stream_fps",
+            # step-compression fields (ISSUE 20): absent on older lines —
+            # the consumer parses via bench_block_fuse_of (xla/bf16)
+            "block_fuse", "fwd_dtype",
             # audit self-reports (ISSUE 19): a surfaced on-chip number
             # keeps its hygiene verdicts attached
             "donation_ok", "lock_audit_clean", "transfer_audit_ok")
@@ -867,6 +888,17 @@ def _bench(out: dict, hb) -> None:
         # program, and the line says so (sentinel: "off").
         sentinel_on = (os.environ.get("BENCH_SENTINEL") == "1"
                        or "--sentinel" in sys.argv)
+        # BENCH_BLOCK_FUSE={auto,fused,xla} / BENCH_FWD_DTYPE={bf16,int8}
+        # (ISSUE 20): the residual-block tail pass family and the STE
+        # forward dtype under A/B, same contract as BENCH_EPILOGUE. int8
+        # forward needs the bf16 compute dtype (STE accumulates in int32
+        # and rescales into the compute dtype), so it is forced back to
+        # bf16 under BENCH_DTYPE=fp32 like the param policy above.
+        fwd_dtype = os.environ.get("BENCH_FWD_DTYPE", "bf16")
+        if dtype is None and fwd_dtype != "bf16":
+            log("BENCH_FWD_DTYPE=%s needs bf16 (--amp); forcing bf16"
+                % fwd_dtype)
+            fwd_dtype = "bf16"
         tcfg = Config(num_cls=2,
                       batch_size=train_batch, amp=dtype is not None,
                       imsize=imsize, **arch,
@@ -875,6 +907,9 @@ def _bench(out: dict, hb) -> None:
                                                  "auto"),
                       param_policy=param_policy,
                       epilogue=os.environ.get("BENCH_EPILOGUE", "auto"),
+                      block_fuse=os.environ.get("BENCH_BLOCK_FUSE",
+                                                "auto"),
+                      fwd_dtype=fwd_dtype,
                       sentinel=sentinel_on)
         tmodel = build_model(tcfg, dtype=dtype)
         tx = build_optimizer(tcfg, 100)
@@ -925,10 +960,23 @@ def _bench(out: dict, hb) -> None:
             # approved.
             from real_time_helmet_detection_tpu.analysis.transfer_audit \
                 import bench_transfer_ok
+            from real_time_helmet_detection_tpu.models import \
+                resolve_block_fuse as _rbf
+            # mode-matched manifest entry: sentinel wins (it changes the
+            # fetched-leaf count), then the ISSUE-20 train modes — both
+            # budget-identical to the base step, pinned as their own
+            # entries so a regression names the mode that grew
+            if sentinel_on:
+                _t_entry = "train_step_scanned[sentinel]"
+            elif tcfg.fwd_dtype == "int8":
+                _t_entry = "train_step_scanned[fwd=int8]"
+            elif _rbf(tcfg) == "fused":
+                _t_entry = "train_step_scanned[block-fuse]"
+            else:
+                _t_entry = "train_step_scanned"
             out["transfer_audit_ok"] = bench_transfer_ok(
                 train_n, (state, *arrs), donate_argnums=(0,),
-                entry=("train_step_scanned[sentinel]" if sentinel_on
-                       else "train_step_scanned"))
+                entry=_t_entry)
         except Exception as e:  # noqa: BLE001 — never block the bench
             log("transfer audit unavailable: %r" % e)
         # warmup run consumes (donates) `state`; rebuild for the timed run.
@@ -971,13 +1019,16 @@ def _bench(out: dict, hb) -> None:
             out["mfu_train"] = round(train_flops * n_train / dt / peak, 4)
         # why-MFU-moved context for the BENCH_rNN trajectory: the active
         # step-compression settings + the step's cost-analysis HBM bytes
-        from real_time_helmet_detection_tpu.models import resolve_epilogue
+        from real_time_helmet_detection_tpu.models import (
+            resolve_block_fuse, resolve_epilogue)
         from real_time_helmet_detection_tpu.train import resolve_loss_kernel
         out["hbm_bytes_per_step"] = train_bytes
         out["remat"] = tcfg.remat
         out["loss_kernel"] = resolve_loss_kernel(tcfg)
         out["param_policy"] = tcfg.param_policy
         out["epilogue"] = resolve_epilogue(tcfg)
+        out["block_fuse"] = resolve_block_fuse(tcfg)
+        out["fwd_dtype"] = tcfg.fwd_dtype
         out["mfu_peak_flops"] = peak
         out["mfu_peak_known"] = peak_known
         try:

@@ -258,7 +258,8 @@ TIER_AUDIT = (
 
 
 def _tiny_train_parts(remat: str = "none", param_policy: str = "fp32",
-                      arch: Optional[dict] = None):
+                      arch: Optional[dict] = None,
+                      block_fuse: str = "auto", fwd_dtype: str = "bf16"):
     import jax
     import jax.numpy as jnp
 
@@ -273,7 +274,8 @@ def _tiny_train_parts(remat: str = "none", param_policy: str = "fp32",
     tiny = dict(_TINY, **(arch or {}))
     cfg = Config(batch_size=_BATCH, remat=remat, loss_kernel="xla",
                  amp=param_policy == "bf16-compute",
-                 param_policy=param_policy, **tiny)
+                 param_policy=param_policy, block_fuse=block_fuse,
+                 fwd_dtype=fwd_dtype, **tiny)
     model = build_model(cfg, dtype=jnp.bfloat16 if cfg.amp else None)
     tx = build_optimizer(cfg, 10)
     state = create_train_state(model, cfg, jax.random.key(0),
@@ -288,7 +290,8 @@ def _tiny_train_parts(remat: str = "none", param_policy: str = "fp32",
 def _tiny_predict_parts(normalize: Optional[str] = None,
                         epilogue: str = "auto",
                         arch: Optional[dict] = None,
-                        cascade_summary: bool = False):
+                        cascade_summary: bool = False,
+                        block_fuse: str = "auto"):
     import jax
     import numpy as np
 
@@ -298,6 +301,7 @@ def _tiny_predict_parts(normalize: Optional[str] = None,
     from ..train import init_variables
 
     cfg = Config(topk=16, conf_th=0.0, nms_th=0.5, epilogue=epilogue,
+                 block_fuse=block_fuse,
                  **dict(_TINY, **(arch or {})))
     model = build_model(cfg)
     params, batch_stats = init_variables(model, jax.random.key(0),
@@ -385,9 +389,12 @@ def audit_repo_entry_points(lower: bool = True) -> List[Finding]:
     Entries mirror the production surfaces: the scanned train step
     (bench.py/scaling.py's timed program) across the tpu_sweep
     step-grid remat policies AND under --param-policy bf16-compute (the
-    fp32-master state restructure, ISSUE 7), the jitted predict fn
+    fp32-master state restructure, ISSUE 7), under --block-fuse fused
+    and --fwd-dtype int8 (the residual-tail custom_vjp pass and the STE
+    int8 forward, ISSUE 20), the jitted predict fn
     (eval), its --epilogue fused twin (the custom_vjp BN+activation
-    epilogue), the donating predict chain (bench), the quantized int8
+    epilogue), its --block-fuse fused twin, the donating predict chain
+    (bench), the quantized int8
     predict + its donating chain (--infer-dtype int8, ops/quant.py — the
     program tpu_sweep's int8 section times), the raw-uint8-wire predict
     (eval driver / export --export-raw-input), and the export fn (the
@@ -514,6 +521,62 @@ def audit_repo_entry_points(lower: bool = True) -> List[Finding]:
         findings.append(Finding(
             rule="trace/trace-failure", path="<predict_epilogue_fused>",
             context="predict_epilogue_fused",
+            message="entry construction failed: %s: %s"
+                    % (type(e).__name__,
+                       (str(e).splitlines() or ["?"])[0][:200])))
+
+    try:
+        # the block-fused scanned step (--block-fuse fused, ISSUE 20):
+        # the residual tail's one-pass BN+add+act custom_vjp replaces the
+        # unfused chain in every eligible block — its scan must keep the
+        # exact donation/f64/dynamic-shape surface of the plain step
+        # (off-TPU this audits the jnp recompute twin, the same program
+        # roofline counts)
+        entry = "train_step_scanned[block-fuse]"
+        train_n, targs = _tiny_train_parts(block_fuse="fused")
+        findings += audit_entry(train_n, targs, entry,
+                                donate_argnums=(0,), lower=lower)
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            rule="trace/trace-failure",
+            path="<train_step_scanned[block-fuse]>",
+            context="train_step_scanned[block-fuse]",
+            message="entry construction failed: %s: %s"
+                    % (type(e).__name__,
+                       (str(e).splitlines() or ["?"])[0][:200])))
+
+    try:
+        # the int8-forward scanned step (--fwd-dtype int8, ISSUE 20): the
+        # STE conv quantizes per step IN-JIT (absmax ride-along, no
+        # persisted scale state) — a host-side scale refresh or a fresh
+        # un-donated buffer here would leak a D2H per step into the train
+        # loop, exactly what this audit exists to catch
+        entry = "train_step_scanned[fwd=int8]"
+        train_n, targs = _tiny_train_parts(fwd_dtype="int8")
+        findings += audit_entry(train_n, targs, entry,
+                                donate_argnums=(0,), lower=lower)
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            rule="trace/trace-failure",
+            path="<train_step_scanned[fwd=int8]>",
+            context="train_step_scanned[fwd=int8]",
+            message="entry construction failed: %s: %s"
+                    % (type(e).__name__,
+                       (str(e).splitlines() or ["?"])[0][:200])))
+
+    try:
+        # the block-fused predict (ISSUE 20): the eval-mode fused pass
+        # folds running stats into eff-scale/bias before the one-pass
+        # add+act — same cleanliness bar as predict_epilogue_fused
+        predict_b, variables_b, images_b = _tiny_predict_parts(
+            block_fuse="fused")
+        findings += audit_entry(
+            lambda v, im: predict_b(v, im), (variables_b, images_b),
+            "predict_block_fused", lower=lower)
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            rule="trace/trace-failure", path="<predict_block_fused>",
+            context="predict_block_fused",
             message="entry construction failed: %s: %s"
                     % (type(e).__name__,
                        (str(e).splitlines() or ["?"])[0][:200])))

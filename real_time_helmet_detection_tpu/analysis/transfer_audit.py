@@ -143,11 +143,15 @@ def measure_entry(fn: Callable, args: Sequence,
 
 
 def _train_parts(telemetry: bool = False, sentinel: bool = False,
-                 param_policy: str = "fp32", distill: bool = False):
+                 param_policy: str = "fp32", distill: bool = False,
+                 block_fuse: str = "auto", fwd_dtype: str = "bf16"):
     """The scanned-train-step family at trace_audit's tiny config: the
     exact programs bench.py/scaling.py time, across the mode knobs that
     reshape the fetched surface (telemetry ring, sentinel skip counter,
-    fp32-master state restructure, in-jit distill teacher)."""
+    fp32-master state restructure, in-jit distill teacher) — plus the
+    ISSUE-20 modes (block-fused residual tail, int8 STE forward), which
+    must keep the base step's budget EXACTLY: the fused pass and the
+    per-step scale refresh are both in-jit by construction."""
     import jax
     import jax.numpy as jnp
 
@@ -162,7 +166,8 @@ def _train_parts(telemetry: bool = False, sentinel: bool = False,
     cfg = Config(batch_size=_BATCH, remat="none", loss_kernel="xla",
                  amp=param_policy == "bf16-compute",
                  param_policy=param_policy, telemetry=telemetry,
-                 sentinel=sentinel, **_TINY)
+                 sentinel=sentinel, block_fuse=block_fuse,
+                 fwd_dtype=fwd_dtype, **_TINY)
     model = build_model(cfg, dtype=jnp.bfloat16 if cfg.amp else None)
     tx = build_optimizer(cfg, 10)
     state = create_train_state(model, cfg, jax.random.key(0),
@@ -266,6 +271,10 @@ ENTRY_POINTS: Dict[str, Tuple[Callable, Tuple[str, ...]]] = {
         lambda: _train_parts(param_policy="bf16-compute"), _TRAIN_MODS),
     "train_step_scanned[distill]": (
         lambda: _train_parts(distill=True), _TRAIN_MODS),
+    "train_step_scanned[block-fuse]": (
+        lambda: _train_parts(block_fuse="fused"), _TRAIN_MODS),
+    "train_step_scanned[fwd=int8]": (
+        lambda: _train_parts(fwd_dtype="int8"), _TRAIN_MODS),
     "predict": (lambda: _predict_parts(), _PREDICT_MODS),
     "predict_chain": (_chain_parts, _PREDICT_MODS),
     "predict_cascade_summary[tier=edge]": (

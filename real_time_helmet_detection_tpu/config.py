@@ -352,6 +352,30 @@ class Config:
     # in {Mish, ReLU, Linear}, per-replica BN (sync-BN keeps xla) —
     # ineligible convs silently keep the xla tail. Checkpoints
     # interchange across modes (identical param tree, tested).
+    block_fuse: str = "auto"      # residual-block TAIL implementation:
+    # "xla" (per-conv epilogue + XLA skip-add + Activation, the pre-PR
+    # composition), "fused" (the block's second BN, the skip-add and the
+    # closing activation collapse into ONE custom_vjp pass family with
+    # the analytic BN backward extended through the add,
+    # ops/pallas/residual.py — Pallas on TPU, the jnp twin elsewhere),
+    # "auto" = fused on TPU, xla elsewhere (the --epilogue gating).
+    # Eligibility per block: residual/depthwise variants (ghost's tail
+    # is a concat of two separately-normalized halves), per-replica
+    # unfolded BN, no quantization, closing activation in {Mish, ReLU,
+    # Linear} — ineligible blocks silently keep the xla tail. Param/stat
+    # trees are IDENTICAL to today, so checkpoints interchange and
+    # fold_batchnorm/int8 export apply unchanged (tested).
+    fwd_dtype: str = "bf16"       # TRAIN-time forward conv compute dtype:
+    # "bf16" (the --amp baseline) or "int8" — eligible convs (BN'd,
+    # bias-free, unquantized, unfolded) run their train-mode forward as
+    # int8 x int8 -> int32 via PR 5's quantization algebra with a
+    # PER-STEP in-jit absmax scale refresh (no persisted scale state:
+    # trees, donation and the D2H budget are unchanged), and a
+    # straight-through-estimator backward differentiates the float conv
+    # twin. v5e int8 peak is 2x bf16 (394 TOPS). Train-only: eval/
+    # predict bind the same float params; composes with --grad-accum/
+    # --sentinel/--distill. Gate on loss-curve parity vs the bf16 twin
+    # exactly like bf16-compute was (tests/test_fwd_dtype.py).
     stem_s2d: bool = False        # compute the 7x7 s2 stem conv in its
     # space-to-depth formulation (same arithmetic, MXU-friendlier
     # contraction; checkpoint-compatible either way)
@@ -434,7 +458,8 @@ class Config:
     # --no-summary disables). Shape inference only — no device compute.
     preset: str = ""              # "" | "sweep-best": override the
     # step-compression train flags (batch-size, remat, loss-kernel,
-    # param-policy, epilogue[, amp]) from the newest committed
+    # param-policy, epilogue, block-fuse, fwd-dtype[, amp]) from the
+    # newest committed
     # `step_grid_selected` record in artifacts/*/sweep.json — the chip's
     # own measured pick promoted to defaults (ISSUE 7 satellite). The
     # preset WINS over individually-passed step flags (it is the "use
@@ -455,6 +480,12 @@ class Config:
         if self.epilogue not in ("auto", "fused", "xla"):
             raise ValueError("--epilogue must be one of auto|fused|xla, "
                              "got %r" % (self.epilogue,))
+        if self.block_fuse not in ("auto", "fused", "xla"):
+            raise ValueError("--block-fuse must be one of auto|fused|xla, "
+                             "got %r" % (self.block_fuse,))
+        if self.fwd_dtype not in ("bf16", "int8"):
+            raise ValueError("--fwd-dtype must be 'bf16' or 'int8', "
+                             "got %r" % (self.fwd_dtype,))
         if self.param_policy not in ("fp32", "bf16-compute"):
             raise ValueError("--param-policy must be 'fp32' or "
                              "'bf16-compute', got %r" % (self.param_policy,))
@@ -621,7 +652,8 @@ def sweep_best_overrides(repo_root: Optional[str] = None) -> dict:
 
     Scans artifacts/*/sweep.json for a `step_grid_selected` record (the
     best-throughput cell of tpu_sweep's batch x remat x loss-kernel x
-    param-policy x epilogue grid) and maps it onto Config field overrides.
+    param-policy x epilogue x block-fuse x fwd-dtype grid) and maps it
+    onto Config field overrides.
     Highest round wins — the committed artifact IS the promotion record,
     so `--preset sweep-best` always tracks the chip's latest verdict.
     Raises FileNotFoundError when no artifact carries a selection (a
@@ -654,9 +686,9 @@ def sweep_best_overrides(repo_root: Optional[str] = None) -> dict:
     over = {"batch_size": int(rec["batch"]),
             "remat": rec.get("remat", "none"),
             "loss_kernel": rec.get("loss_kernel", "auto")}
-    # pre-ISSUE-7 selections lack the new axes: leave those fields at
-    # their CLI/default values rather than inventing a policy
-    for key in ("param_policy", "epilogue"):
+    # pre-ISSUE-7/-20 selections lack the newer axes: leave those fields
+    # at their CLI/default values rather than inventing a policy
+    for key in ("param_policy", "epilogue", "block_fuse", "fwd_dtype"):
         if key in rec:
             over[key] = rec[key]
     if over.get("param_policy") == "bf16-compute":

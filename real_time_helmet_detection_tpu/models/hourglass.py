@@ -30,7 +30,9 @@ import flax.linen as nn
 
 from ..ops.pallas.epilogue import (FUSED_EPILOGUE_ACTIVATIONS, fused_bn_act,
                                    fused_bn_act_train)
-from ..ops.quant import quantize_activations, quantize_weights
+from ..ops.pallas.residual import fused_bn_add_act, fused_bn_add_act_train
+from ..ops.quant import (make_ste_conv, quantize_activations,
+                         quantize_weights)
 
 Dtype = Any
 
@@ -45,6 +47,18 @@ QUANT_MODES = ("off", "calibrate", "int8")
 # "fused" = the one-pass BN-normalize+activation epilogue
 # (ops/pallas/epilogue.py) where eligible.
 EPILOGUE_MODES = ("xla", "fused")
+
+# residual-block TAIL implementations (--block-fuse; ISSUE 20): "xla" =
+# per-conv epilogue + XLA skip-add + Activation (the pre-PR composition,
+# bit-exact), "fused" = BN + skip-add + closing activation collapsed into
+# one custom_vjp pass family (ops/pallas/residual.py) where eligible.
+BLOCK_FUSE_MODES = ("xla", "fused")
+
+# train-time forward conv compute dtypes (--fwd-dtype; ISSUE 20): "bf16"
+# = the --amp baseline; "int8" = eligible convs run their TRAIN forward
+# on the int8 MXU path with a straight-through-estimator backward
+# (ops/quant.make_ste_conv). ONE vocabulary with config.py's validation.
+FWD_DTYPES = ("bf16", "int8")
 
 # residual-block variants (ISSUE 13; Lighter Stacked Hourglass, arxiv
 # 2107.13643): the `variant` axis of the latency-tier model family. ONE
@@ -63,6 +77,18 @@ def resolve_epilogue(cfg) -> str:
     gates the fused loss (off-TPU 'fused' runs the jnp recompute twin —
     test/attribution contexts select it explicitly)."""
     mode = getattr(cfg, "epilogue", "auto")
+    if mode == "auto":
+        import jax
+        return "fused" if jax.default_backend() == "tpu" else "xla"
+    return mode
+
+
+def resolve_block_fuse(cfg) -> str:
+    """'fused' | 'xla' for this backend: --block-fuse auto selects the
+    fused residual-block tail on TPU only, exactly as --epilogue gates
+    the per-conv epilogue (off-TPU 'fused' runs the jnp recompute twin —
+    test/attribution contexts select it explicitly)."""
+    mode = getattr(cfg, "block_fuse", "auto")
     if mode == "auto":
         import jax
         return "fused" if jax.default_backend() == "tpu" else "xla"
@@ -271,6 +297,39 @@ class QuantConv(nn.Module):
         return y + bias.astype(dt)
 
 
+class STEConv(nn.Module):
+    """Int8-forward TRAIN conv body (`--fwd-dtype int8`, ISSUE 20).
+
+    Param tree is IDENTICAL to `nn.Conv(use_bias=False)` ('kernel' HWIO,
+    same lecun-normal init at the same "Conv_0" path), so the SAME
+    checkpoint trains under either forward dtype and eval/predict bind
+    the float path unchanged — the StemConv/QuantConv tree-compat law.
+
+    The forward runs `ops/quant.make_ste_conv`: int8 x int8 -> int32 on
+    the MXU (the v5e's 394-TOPS path, 2x bf16 peak) with a per-step
+    in-jit abs-max activation scale and per-output-channel weight scales,
+    and a straight-through-estimator backward through the float conv
+    twin — gradients are exactly the bf16 program's. No scale state is
+    persisted anywhere (contrast QuantConv's calibrated `quant`
+    collection): trees, donation and the D2H budget are untouched."""
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 1
+    groups: int = 1
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        k = self.kernel_size
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (k, k, x.shape[-1] // self.groups,
+                             self.features))
+        dt = self.dtype or x.dtype
+        fn = make_ste_conv(self.stride, self.padding, self.groups)
+        return fn(x.astype(dt), kernel.astype(dt))
+
+
 class FusedBNAct(nn.Module):
     """BatchNorm + activation with the normalize+activation chain collapsed
     into ONE pointwise pass (ops/pallas/epilogue.py; `--epilogue fused`).
@@ -331,6 +390,56 @@ class FusedBNAct(nn.Module):
                             activation=self.activation)
 
 
+class FusedBNAddAct(nn.Module):
+    """BatchNorm + skip-add + activation with the whole residual-block
+    TAIL collapsed into ONE pass family (ops/pallas/residual.py;
+    `--block-fuse fused`, ISSUE 20).
+
+    The FusedBNAct contract, extended through the add: param and
+    batch_stats trees are IDENTICAL to `nn.BatchNorm(momentum=0.9,
+    epsilon=1e-5)` and the block instantiates it under the same
+    "BatchNorm_0" name inside the tail conv's scope, so checkpoints
+    interchange across every --block-fuse/--epilogue mode and
+    `ops.quant.fold_batchnorm` folds this block exactly as it folds
+    nn.BatchNorm (regression-tested). Batch moments are of the BN input
+    x ALONE — the skip never enters the statistics, exactly as in the
+    unfused composition — and the custom_vjp's analytic backward carries
+    the skip's pass-through gradient, so XLA never materializes the
+    normalized tensor, the sum, or backward-through-stats chains."""
+    activation: str = "Mish"
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, skip: jax.Array,
+                 train: bool = False) -> jax.Array:
+        feat = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((feat,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((feat,), jnp.float32))
+        scale = self.param("scale", nn.initializers.ones_init(), (feat,),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(), (feat,),
+                          jnp.float32)
+        if train:
+            out, mean, var = fused_bn_add_act_train(
+                x, scale, bias, skip, eps=self.epsilon,
+                activation=self.activation)
+            if not self.is_initializing():
+                m = self.momentum
+                mean = jax.lax.stop_gradient(mean)
+                var = jax.lax.stop_gradient(var)
+                ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
+                ra_var.value = m * ra_var.value + (1.0 - m) * var
+            return out
+        eff_scale = scale * jax.lax.rsqrt(ra_var.value + self.epsilon)
+        eff_bias = bias - ra_mean.value * eff_scale
+        return fused_bn_add_act(x, eff_scale, eff_bias, skip,
+                                activation=self.activation)
+
+
 class Convolution(nn.Module):
     """Conv -> optional BN -> activation (ref hourglass.py:94-108), with the
     reference's symmetric (k-1)//2 padding.
@@ -350,7 +459,20 @@ class Convolution(nn.Module):
     per-replica (cross-replica sync-BN keeps the XLA path: its stats
     collective belongs to XLA). Ineligible combinations silently keep the
     xla path — the decision table lives in docs/ARCHITECTURE.md "Step
-    compression"."""
+    compression".
+
+    A non-None `skip` (ISSUE 20; `--block-fuse fused`, passed ONLY by
+    `Residual` on its tail conv) extends that tail through the
+    skip-add: `FusedBNAddAct` computes BN + add + activation in one pass
+    family with the skip's pass-through gradient. Eligibility is the
+    caller's job; this block only enforces the contract.
+
+    `fwd_dtype="int8"` (ISSUE 20) swaps the TRAIN-mode conv body for
+    `STEConv` (int8 MXU forward, straight-through float backward) where
+    eligible: BN'd, bias-free, unquantized, unfolded — the stem
+    (quantize=False) and the bn-less heads/merges keep the float body
+    (the first/last-layer rule, shared with `quant_mode`). Eval always
+    binds the float body over the same params."""
     out_ch: int
     kernel_size: int = 3
     stride: int = 1
@@ -369,9 +491,13 @@ class Convolution(nn.Module):
     calib_percentile: float = 100.0
     quantize: bool = True   # eligibility: PreLayer's stem opts out
     epilogue: str = "xla"   # xla | fused (see EPILOGUE_MODES)
+    fwd_dtype: str = "bf16"  # bf16 | int8 (see FWD_DTYPES): train-time
+    # forward conv compute dtype; "int8" swaps eligible train-mode conv
+    # bodies for STEConv
 
     @nn.compact
-    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+    def __call__(self, x: jax.Array, train: bool = False,
+                 skip: Optional[jax.Array] = None) -> jax.Array:
         k, p = self.kernel_size, (self.kernel_size - 1) // 2
         fold = self.bn and self.fold_bn
         quant_active = self.quant_mode != "off" and self.quantize and self.bn
@@ -380,6 +506,16 @@ class Convolution(nn.Module):
                 "quant_mode=%r requires fold_bn: BN must be folded into "
                 "the conv before its weights are quantized (ops/quant.py)"
                 % self.quant_mode)
+        if skip is not None and (
+                fold or not self.bn or self.bn_axis_name is not None
+                or self.activation not in FUSED_EPILOGUE_ACTIVATIONS):
+            raise ValueError(
+                "block-fused tail requires an unfolded per-replica BN "
+                "and an activation in %s — the caller (Residual) gates "
+                "eligibility" % (FUSED_EPILOGUE_ACTIVATIONS,))
+        ste_active = (self.fwd_dtype == "int8" and train and self.bn
+                      and not fold and self.quant_mode == "off"
+                      and self.quantize and not self.use_bias)
         if self.stem_s2d and k == 7 and self.stride == 2 and self.use_bias:
             # name matches the nn.Conv auto-name so the param tree (and
             # every checkpoint) is identical whichever path computes it
@@ -391,6 +527,10 @@ class Convolution(nn.Module):
                           mode=self.quant_mode,
                           calib_percentile=self.calib_percentile,
                           dtype=self.dtype, name="Conv_0")(x)
+        elif ste_active:
+            x = STEConv(self.out_ch, kernel_size=k, stride=self.stride,
+                        padding=p, groups=self.groups,
+                        dtype=self.dtype, name="Conv_0")(x)
         else:
             x = nn.Conv(self.out_ch, (k, k),
                         strides=(self.stride, self.stride),
@@ -399,6 +539,14 @@ class Convolution(nn.Module):
                         use_bias=self.use_bias or fold,
                         dtype=self.dtype)(x)
         if self.bn and not self.fold_bn:
+            if skip is not None:
+                # block-fused tail: BN + skip-add + closing activation in
+                # one custom_vjp family; same "BatchNorm_0" name as the
+                # nn.BatchNorm auto-name, so the param tree (and every
+                # checkpoint) is identical whichever tail computes it
+                return FusedBNAddAct(activation=self.activation,
+                                     dtype=self.dtype,
+                                     name="BatchNorm_0")(x, skip, train)
             if (self.epilogue == "fused" and self.bn_axis_name is None
                     and self.activation in FUSED_EPILOGUE_ACTIVATIONS):
                 # same "BatchNorm_0" name as the nn.BatchNorm auto-name:
@@ -430,6 +578,7 @@ class GhostModule(nn.Module):
     quant_mode: str = "off"
     calib_percentile: float = 100.0
     epilogue: str = "xla"
+    fwd_dtype: str = "bf16"
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -441,7 +590,7 @@ class GhostModule(nn.Module):
         kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
                   fold_bn=self.fold_bn, quant_mode=self.quant_mode,
                   calib_percentile=self.calib_percentile,
-                  epilogue=self.epilogue)
+                  epilogue=self.epilogue, fwd_dtype=self.fwd_dtype)
         primary = Convolution(half, 1, self.stride, use_bias=False,
                               bn=True, activation=self.activation,
                               **kw)(x, train)
@@ -464,7 +613,20 @@ class Residual(nn.Module):
 
     Skip path and post-add activation are identical across variants, so
     the block's I/O contract (and the surrounding Hourglass geometry)
-    never changes."""
+    never changes.
+
+    `block_fuse="fused"` (ISSUE 20) collapses the block TAIL — the last
+    conv's BN, the skip-add and the post-add activation — into one
+    custom_vjp pass family (ops/pallas/residual.py via `FusedBNAddAct`)
+    where ELIGIBLE: residual/depthwise variants (ghost's tail is a
+    concat of two separately-normalized GhostModule halves — there is no
+    single BN feeding the add), no quantization/folding, per-replica BN,
+    post-add activation in FUSED_EPILOGUE_ACTIVATIONS. Ineligible blocks
+    silently keep the xla tail (bit-exact pre-PR program). The fused
+    branch names its children explicitly to match the unfused branch's
+    auto-names — flax derives param RNGs and tree keys from the module
+    PATH, so the trees (values included) are identical and checkpoints
+    interchange (tested)."""
     out_ch: int
     kernel_size: int = 3
     stride: int = 1
@@ -476,13 +638,22 @@ class Residual(nn.Module):
     quant_mode: str = "off"
     calib_percentile: float = 100.0
     epilogue: str = "xla"
+    block_fuse: str = "xla"  # xla | fused (see BLOCK_FUSE_MODES)
+    fwd_dtype: str = "bf16"
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
                   fold_bn=self.fold_bn, quant_mode=self.quant_mode,
                   calib_percentile=self.calib_percentile,
-                  epilogue=self.epilogue)
+                  epilogue=self.epilogue, fwd_dtype=self.fwd_dtype)
+        fuse_tail = (self.block_fuse == "fused"
+                     and self.variant in ("residual", "depthwise")
+                     and self.quant_mode == "off" and not self.fold_bn
+                     and self.bn_axis_name is None
+                     and self.activation in FUSED_EPILOGUE_ACTIVATIONS)
+        if fuse_tail:
+            return self._fused(x, train, kw)
         if self.variant == "depthwise":
             in_ch = x.shape[-1]
             y = Convolution(in_ch, self.kernel_size, self.stride,
@@ -517,6 +688,50 @@ class Residual(nn.Module):
                             bn=True, activation="Linear", **kw)(x, train)
         return Activation(self.activation)(y + x)
 
+    def _fused(self, x: jax.Array, train: bool, kw: dict) -> jax.Array:
+        """Fused-tail body (still inside the compact __call__ context).
+
+        The SKIP branch is computed BEFORE the tail conv so it can feed
+        the fused pass, but keeps its unfused auto-name (body convs take
+        Convolution_0..n-1, the skip takes Convolution_n) so the param
+        tree — and the path-derived init RNGs — are bit-identical to the
+        xla composition. The tail Convolution carries the POST-ADD
+        activation (the unfused tail is Linear and the activation sits
+        after the add; fusing folds it into the same pass)."""
+        if self.variant == "depthwise":
+            in_ch = x.shape[-1]
+            y = Convolution(in_ch, self.kernel_size, self.stride,
+                            use_bias=False, bn=True,
+                            activation=self.activation, groups=in_ch,
+                            name="Convolution_0", **kw)(x, train)
+            y = Convolution(self.out_ch, 1, 1, use_bias=False, bn=True,
+                            activation=self.activation,
+                            name="Convolution_1", **kw)(y, train)
+            y = Convolution(self.out_ch, self.kernel_size, 1,
+                            use_bias=False, bn=True,
+                            activation=self.activation,
+                            groups=self.out_ch,
+                            name="Convolution_2", **kw)(y, train)
+            tail = Convolution(self.out_ch, 1, 1, use_bias=False,
+                               bn=True, activation=self.activation,
+                               name="Convolution_3", **kw)
+            skip_name = "Convolution_4"
+        else:  # residual
+            y = Convolution(self.out_ch, self.kernel_size, self.stride,
+                            use_bias=False, bn=True,
+                            activation=self.activation,
+                            name="Convolution_0", **kw)(x, train)
+            tail = Convolution(self.out_ch, self.kernel_size,
+                               self.stride, use_bias=False, bn=True,
+                               activation=self.activation,
+                               name="Convolution_1", **kw)
+            skip_name = "Convolution_2"
+        if x.shape[-1] != self.out_ch:
+            x = Convolution(self.out_ch, 1, self.stride, use_bias=False,
+                            bn=True, activation="Linear",
+                            name=skip_name, **kw)(x, train)
+        return tail(y, train, skip=x)
+
 
 def _upsample_nearest_2x(x: jax.Array) -> jax.Array:
     return jnp.repeat(jnp.repeat(x, 2, axis=-3), 2, axis=-2)
@@ -538,6 +753,8 @@ class Hourglass(nn.Module):
     quant_mode: str = "off"
     calib_percentile: float = 100.0
     epilogue: str = "xla"
+    block_fuse: str = "xla"
+    fwd_dtype: str = "bf16"
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -546,7 +763,8 @@ class Hourglass(nn.Module):
                   bn_axis_name=self.bn_axis_name, fold_bn=self.fold_bn,
                   quant_mode=self.quant_mode,
                   calib_percentile=self.calib_percentile,
-                  epilogue=self.epilogue)
+                  epilogue=self.epilogue, block_fuse=self.block_fuse,
+                  fwd_dtype=self.fwd_dtype)
         mid_ch = self.in_ch + self.increase_ch
 
         up1 = Residual(self.in_ch, **kw)(x, train)
@@ -558,7 +776,8 @@ class Hourglass(nn.Module):
                             self.dtype,
                             self.bn_axis_name, self.fold_bn,
                             self.quant_mode, self.calib_percentile,
-                            self.epilogue)(low, train)
+                            self.epilogue, self.block_fuse,
+                            self.fwd_dtype)(low, train)
         else:
             low = Residual(mid_ch, **kw)(low, train)
         low = Residual(self.in_ch, **kw)(low, train)
@@ -587,13 +806,18 @@ class PreLayer(nn.Module):
     quant_mode: str = "off"
     calib_percentile: float = 100.0
     epilogue: str = "xla"
+    block_fuse: str = "xla"
+    fwd_dtype: str = "bf16"
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
                   fold_bn=self.fold_bn, quant_mode=self.quant_mode,
                   calib_percentile=self.calib_percentile,
-                  epilogue=self.epilogue)
+                  epilogue=self.epilogue, fwd_dtype=self.fwd_dtype)
+        # block_fuse is a Residual-level knob (the block TAIL); the plain
+        # Convolution blocks never see it
+        rkw = dict(kw, block_fuse=self.block_fuse)
         # the stem conv contracts over only 3 input channels and is the
         # first layer: it stays in the float dtype (quantize=False) and is
         # NEVER a variant block (its 147-value contraction is already
@@ -602,10 +826,10 @@ class PreLayer(nn.Module):
                         activation=self.activation,
                         stem_s2d=self.stem_s2d, quantize=False,
                         **kw)(x, train)
-        x = Residual(self.mid_ch, variant=self.variant, **kw)(x, train)
+        x = Residual(self.mid_ch, variant=self.variant, **rkw)(x, train)
         x = Pool(self.mid_ch, self.pool, dtype=self.dtype)(x)
-        x = Residual(self.mid_ch, variant=self.variant, **kw)(x, train)
-        x = Residual(self.out_ch, variant=self.variant, **kw)(x, train)
+        x = Residual(self.mid_ch, variant=self.variant, **rkw)(x, train)
+        x = Residual(self.out_ch, variant=self.variant, **rkw)(x, train)
         return x
 
 
@@ -622,17 +846,20 @@ class Neck(nn.Module):
     quant_mode: str = "off"
     calib_percentile: float = 100.0
     epilogue: str = "xla"
+    block_fuse: str = "xla"
+    fwd_dtype: str = "bf16"
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name,
                   fold_bn=self.fold_bn, quant_mode=self.quant_mode,
                   calib_percentile=self.calib_percentile,
-                  epilogue=self.epilogue)
+                  epilogue=self.epilogue, fwd_dtype=self.fwd_dtype)
         x = Pool(self.ch, self.pool, dtype=self.dtype)(x)
         x = Convolution(self.ch, 1, bn=True, activation=self.activation,
                         **kw)(x, train)
-        x = Residual(self.ch, variant=self.variant, **kw)(x, train)
+        x = Residual(self.ch, variant=self.variant,
+                     block_fuse=self.block_fuse, **kw)(x, train)
         return x
 
 
@@ -687,6 +914,12 @@ class StackedHourglass(nn.Module):
     epilogue: str = "xla"   # conv BN+activation tail: "xla" (the pre-PR
     # nn.BatchNorm + Activation composition) | "fused" (one-pass
     # ops/pallas/epilogue.py kernel where eligible; see Convolution)
+    block_fuse: str = "xla"  # residual-block tail: "xla" (per-conv
+    # epilogue + XLA add + Activation) | "fused" (BN + skip-add +
+    # activation in one ops/pallas/residual.py pass family where
+    # eligible; see Residual). ISSUE 20.
+    fwd_dtype: str = "bf16"  # train-time forward conv compute dtype:
+    # "bf16" | "int8" (STEConv where eligible; see Convolution). ISSUE 20.
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -694,7 +927,8 @@ class StackedHourglass(nn.Module):
                   bn_axis_name=self.bn_axis_name,
                   fold_bn=self.fold_bn, quant_mode=self.quant_mode,
                   calib_percentile=self.calib_percentile,
-                  epilogue=self.epilogue)
+                  epilogue=self.epilogue, block_fuse=self.block_fuse,
+                  fwd_dtype=self.fwd_dtype)
         if self.dtype is not None:
             x = x.astype(self.dtype)
         x = PreLayer(mid_ch=self.stem_width or 128, out_ch=self.in_ch,
@@ -771,4 +1005,6 @@ def build_model(args_or_cfg, dtype: Optional[Dtype] = None,
         quant_mode=quant_mode,
         calib_percentile=calib_percentile,
         epilogue=resolve_epilogue(c),
+        block_fuse=resolve_block_fuse(c),
+        fwd_dtype=getattr(c, "fwd_dtype", "bf16"),
     )

@@ -45,12 +45,17 @@ Three stages, all pure pytree/jnp math (jit-able, CPU-provable):
 The quantized conv itself lives in models/hourglass.py (`QuantConv`):
 int8 x int8 `lax.conv_general_dilated` with
 `preferred_element_type=int32`, then a bf16 rescale `(s_a * s_w)` + bias.
-Training always stays bf16/fp32 — int8 is eval/export only (decision
-table: docs/ARCHITECTURE.md "Inference compression").
+At TRAIN time the same algebra powers `--fwd-dtype int8` (ISSUE 20):
+`make_ste_conv` below runs an eligible conv's forward on the int8 MXU
+path with a PER-STEP in-jit abs-max scale refresh and differentiates the
+float conv twin through a straight-through estimator — no persisted
+scale state, no calibration pass (decision tables:
+docs/ARCHITECTURE.md "Step compression" / "Inference compression").
 """
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import json
 import os
@@ -169,6 +174,71 @@ def quantize_activations(x: jax.Array, absmax: jax.Array) -> Tuple[jax.Array,
     q = jnp.clip(jnp.round(jnp.asarray(x, jnp.float32) / scale),
                  -127, 127).astype(jnp.int8)
     return q, scale
+
+
+# ---------------------------------------------------------------------------
+# int8-forward training (--fwd-dtype int8, ISSUE 20)
+
+
+@functools.lru_cache(maxsize=None)
+def make_ste_conv(stride: int, padding: int, groups: int):
+    """custom_vjp'd `(x, kernel) -> conv(x, kernel)` whose FORWARD runs
+    int8 x int8 -> int32 on the MXU and whose BACKWARD differentiates the
+    float conv twin (a straight-through estimator through the
+    quantize/dequantize round trip).
+
+    Forward: the activation clip range is the batch's own abs-max,
+    recomputed IN-JIT every step (the "per-step scale refresh") — unlike
+    the inference path there is no calibration artifact and no persisted
+    scale state, so the train state trees, buffer donation and the D2H
+    budget are byte-identical to the bf16 program. Weights quantize
+    per-output-channel from the compute-dtype kernel each step
+    (`quantize_weights`), activations per-tensor (`quantize_activations`);
+    the rescale `acc * (s_a * s_w)` lands back in the compute dtype.
+
+    Backward: `jax.vjp` of the float `lax.conv_general_dilated` with the
+    SAME geometry — the STE treats round/clip as identity, so gradients
+    are exactly the bf16 twin's. The float forward primal is dead code
+    in both passes (the int8 path produces the primal; the conv VJP's
+    residuals are the already-saved inputs) and XLA removes it.
+
+    Static geometry baked per cache entry so the SAME function object is
+    reused across traces (retrace-stable, graftlint layer 1).
+    """
+    dn = ("NHWC", "HWIO", "NHWC")
+    pad = ((padding, padding), (padding, padding))
+
+    def float_conv(x, kernel):
+        return jax.lax.conv_general_dilated(
+            x, kernel, (stride, stride), pad, dimension_numbers=dn,
+            feature_group_count=groups)
+
+    def int8_fwd(x, kernel):
+        absmax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+        xq, a_scale = quantize_activations(x, absmax)
+        wq, w_scale = quantize_weights(kernel)
+        acc = jax.lax.conv_general_dilated(
+            xq, wq, (stride, stride), pad, dimension_numbers=dn,
+            preferred_element_type=jnp.int32,
+            feature_group_count=groups)
+        return acc.astype(x.dtype) * (a_scale * w_scale).astype(x.dtype)
+
+    @jax.custom_vjp
+    def ste_conv(x, kernel):
+        return int8_fwd(x, kernel)
+
+    def ste_fwd(x, kernel):
+        # residuals are the ALREADY-materialized inputs — exactly what
+        # the float conv's VJP needs, nothing extra crosses HBM
+        return int8_fwd(x, kernel), (x, kernel)
+
+    def ste_bwd(res, g):
+        x, kernel = res
+        _, vjp = jax.vjp(float_conv, x, kernel)
+        return vjp(g)
+
+    ste_conv.defvjp(ste_fwd, ste_bwd)
+    return ste_conv
 
 
 # ---------------------------------------------------------------------------

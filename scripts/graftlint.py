@@ -920,6 +920,27 @@ def _selfcheck_trace(check) -> None:
                         (variables_e, images_e), "predict_epilogue_fused")
     check("fused-epilogue predict audits clean", not ef)
 
+    # the ISSUE-20 step-compression surfaces: the block-fused scanned
+    # step (residual-tail BN+add+act custom_vjp), the int8-STE-forward
+    # scanned step (per-step in-jit scale refresh), and the block-fused
+    # predict — each must keep the plain step's donation/f64/dynamic-
+    # shape surface (the repo baseline stays EMPTY)
+    train_bf, targs_bf = ta._tiny_train_parts(block_fuse="fused")
+    bff = ta.audit_entry(train_bf, targs_bf,
+                         "train_step_scanned[block-fuse]",
+                         donate_argnums=(0,))
+    check("block-fused scanned step audits clean", not bff)
+    train_i8, targs_i8 = ta._tiny_train_parts(fwd_dtype="int8")
+    i8f = ta.audit_entry(train_i8, targs_i8,
+                         "train_step_scanned[fwd=int8]",
+                         donate_argnums=(0,))
+    check("int8-forward scanned step audits clean", not i8f)
+    predict_bf, variables_bf, images_bf = ta._tiny_predict_parts(
+        block_fuse="fused")
+    pbf = ta.audit_entry(lambda v, im: predict_bf(v, im),
+                         (variables_bf, images_bf), "predict_block_fused")
+    check("block-fused predict audits clean", not pbf)
+
     # the cascade-summary predict (ISSUE 16): the edge serving program
     # with the in-jit confidence summary — the FleetRouter escalation
     # signal rides this trace, so dynamic shapes/f64/retrace instability

@@ -437,6 +437,12 @@ def obs_from_roofline(d: Dict, rnd: int, source: str) -> List[Obs]:
         sig += ",s=%s" % cfg["num_stack"]
     if cfg.get("width", 128) != 128:
         sig += ",w=%s" % cfg["width"]
+    # step-compression lever discriminators (ISSUE 20): absent on
+    # historical artifacts and at their defaults, so old keys stay stable
+    if cfg.get("block_fuse", "auto") != "auto":
+        sig += ",bfuse=%s" % cfg["block_fuse"]
+    if cfg.get("fwd_dtype", "bf16") != "bf16":
+        sig += ",fwd=%s" % cfg["fwd_dtype"]
     out = []
     summary = d.get("summary") or {}
     total = summary.get("total_bytes")

@@ -438,7 +438,9 @@ def build_step(jax, args, loss_kernel: str):
                  imsize=args.imsize, remat=args.remat,
                  loss_kernel=loss_kernel,
                  param_policy=getattr(args, "param_policy", "fp32"),
-                 epilogue=getattr(args, "epilogue", "auto"))
+                 epilogue=getattr(args, "epilogue", "auto"),
+                 block_fuse=getattr(args, "block_fuse", "auto"),
+                 fwd_dtype=getattr(args, "fwd_dtype", "bf16"))
     model = build_model(cfg, dtype=jnp.bfloat16)
     tx = build_optimizer(cfg, 100)
     state = create_train_state(model, cfg, jax.random.key(0), args.imsize,
@@ -447,13 +449,18 @@ def build_step(jax, args, loss_kernel: str):
     arrs = tuple(jnp.asarray(a) for a in synthetic_target_batch(
         args.batch, args.imsize, pos_rate=0.01))
     train_n = make_scanned_train_fn(body, args.steps)
-    # site registry: capture ONLY the timed program's epilogue calls
-    # (model.init above also traces the module, in eval mode)
+    # site registries: capture ONLY the timed program's fused-kernel
+    # calls (model.init above also traces the module, in eval mode) —
+    # epilogue.py's BN+act tails and residual.py's BN+add+act tails each
+    # keep their own registry (different per-site transfer counts)
     from real_time_helmet_detection_tpu.ops.pallas import epilogue as _epi
+    from real_time_helmet_detection_tpu.ops.pallas import residual as _res
     _epi.reset_site_registry()
+    _res.reset_site_registry()
     compiled = jax.jit(train_n, donate_argnums=(0,)).lower(
         state, *arrs).compile()
     build_step.epilogue_sites = _epi.traced_sites()
+    build_step.residual_sites = _res.traced_sites()
     remake = lambda: create_train_state(  # noqa: E731 — donation refills
         model, cfg, jax.random.key(0), args.imsize, tx)
     return compiled, state, arrs, remake
@@ -480,7 +487,8 @@ def build_predict(jax, args):
                  # 128 (config.TIER_PRESETS stem_width convention)
                  stem_width=min(128, args.hourglass_inch),
                  topk=100, conf_th=0.0, nms_th=0.5,
-                 imsize=args.imsize, epilogue=args.epilogue)
+                 imsize=args.imsize, epilogue=args.epilogue,
+                 block_fuse=getattr(args, "block_fuse", "auto"))
     model = build_model(cfg, dtype=jnp.bfloat16)
     params, batch_stats = init_variables(model, jax.random.key(0),
                                          args.imsize)
@@ -545,41 +553,66 @@ def loss_subprogram_cost(jax, args, kernel: str):
     return rec
 
 
-def substitute_epilogue_analytic(rows, sites):
-    """Off-TPU, a `--epilogue fused` model compiles the jnp custom_vjp
-    TWIN (ops/pallas/epilogue.py) — a faithful stand-in for semantics and
-    tests, but NOT the program the chip runs: the twin pays CPU-pipeline
-    taxes (materialized f32 views, Gram-dot reduction reads) that the
-    Pallas kernels keep in VMEM/registers. Exactly like
+def substitute_epilogue_analytic(rows, sites, residual_sites=()):
+    """Off-TPU, a `--epilogue fused` / `--block-fuse fused` model
+    compiles the jnp custom_vjp TWINS (ops/pallas/epilogue.py and
+    ops/pallas/residual.py) — faithful stand-ins for semantics and
+    tests, but NOT the programs the chip runs: the twins pay
+    CPU-pipeline taxes (materialized f32 views, Gram-dot reduction
+    reads) that the Pallas kernels keep in VMEM/registers. Exactly like
     `loss_subprogram_cost`'s `kernel_bytes_analytic` (the r07 counting
-    model's documented basis for Pallas paths), the twin's rows —
+    model's documented basis for Pallas paths), each twin's rows —
     identified by their HLO `source_file` metadata — are replaced by the
     REAL kernel sequence's operand+result bytes per traced call site
     (`epilogue.site_kernel_bytes`: train = 8 activation-sized transfers,
-    eval = 2). Twin rows whose fusion roots carry other source metadata
-    stay counted (conservative: overcounts the candidate). Returns
-    (rows, info|None); info rides in the artifact as
-    `epilogue_counting` so the basis is always visible."""
-    from real_time_helmet_detection_tpu.ops.pallas.epilogue import \
-        site_kernel_bytes
-    twin = [r for r in rows if r.get("src") == "epilogue.py"]
-    if not twin or not sites:
-        return rows, None
-    kept = [r for r in rows if r.get("src") != "epilogue.py"]
-    for i, (kind, elems, itemsize) in enumerate(sites):
-        kept.append({
-            "name": "fused_epilogue.%d" % i, "opcode": "custom-call",
-            "class": "elementwise", "src": "epilogue.py",
-            # ~20 f32 ops/element across the 4 passes (act + derivative
-            # recompute); byte-bound either way
-            "flops": 20.0 * elems,
-            "bytes": site_kernel_bytes(kind, elems, itemsize)})
-    info = {"basis": "analytic",
+    eval = 2; `residual.site_kernel_bytes`: train = 12, eval = 3 — the
+    skip tensor rides every pass). Twin rows whose fusion roots carry
+    other source metadata stay counted (conservative: overcounts the
+    candidate). Returns (rows, info|None); info rides in the artifact as
+    `epilogue_counting` — aggregate fields keep the r09 shape, and
+    `families` records each kernel family's twin-vs-kernel bytes side
+    by side (ISSUE 20)."""
+    from real_time_helmet_detection_tpu.ops.pallas import epilogue as _e
+    from real_time_helmet_detection_tpu.ops.pallas import residual as _r
+    families = (
+        ("epilogue.py", "fused_epilogue", _e.site_kernel_bytes,
+         list(sites or ())),
+        ("residual.py", "fused_residual", _r.site_kernel_bytes,
+         list(residual_sites or ())),
+    )
+    kept = list(rows)
+    per_family = {}
+    for src_name, label, kernel_bytes, fam_sites in families:
+        twin = [r for r in kept if r.get("src") == src_name]
+        if not twin or not fam_sites:
+            continue
+        kept = [r for r in kept if r.get("src") != src_name]
+        for i, (kind, elems, itemsize) in enumerate(fam_sites):
+            kept.append({
+                "name": "%s.%d" % (label, i), "opcode": "custom-call",
+                "class": "elementwise", "src": src_name,
+                # ~20 f32 ops/element across the passes (act + derivative
+                # recompute; +skip add for residual); byte-bound either way
+                "flops": (22.0 if src_name == "residual.py" else 20.0)
+                         * elems,
+                "bytes": kernel_bytes(kind, elems, itemsize)})
+        per_family[label] = {
             "twin_rows_dropped": len(twin),
             "twin_rows_bytes": sum(r["bytes"] for r in twin),
             "kernel_bytes_analytic": sum(
-                site_kernel_bytes(k, e, s) for k, e, s in sites),
-            "sites": len(sites)}
+                kernel_bytes(k, e, s) for k, e, s in fam_sites),
+            "sites": len(fam_sites)}
+    if not per_family:
+        return rows, None
+    info = {"basis": "analytic",
+            "twin_rows_dropped": sum(f["twin_rows_dropped"]
+                                     for f in per_family.values()),
+            "twin_rows_bytes": sum(f["twin_rows_bytes"]
+                                   for f in per_family.values()),
+            "kernel_bytes_analytic": sum(f["kernel_bytes_analytic"]
+                                         for f in per_family.values()),
+            "sites": sum(f["sites"] for f in per_family.values()),
+            "families": per_family}
     return kept, info
 
 
@@ -769,6 +802,14 @@ def main() -> None:
                     choices=["fp32", "bf16-compute"])
     ap.add_argument("--epilogue", default="auto",
                     choices=["auto", "fused", "xla"])
+    ap.add_argument("--block-fuse", default="auto",
+                    choices=["auto", "fused", "xla"],
+                    help="residual-block tail pass family (ISSUE 20): "
+                         "fused = the one-pass BN+add+act custom_vjp")
+    ap.add_argument("--fwd-dtype", default="bf16",
+                    choices=["bf16", "int8"],
+                    help="train-forward compute dtype (ISSUE 20): int8 "
+                         "= STE forward, bf16 backward (train mode only)")
     ap.add_argument("--diff", nargs=2, metavar=("BASELINE", "CANDIDATE"),
                     help="join two roofline-v1 artifacts into per-class "
                          "delta tables (no backend; see module docstring)")
@@ -830,11 +871,13 @@ def main() -> None:
         # fused-epilogue analytic basis off-TPU (see the function's
         # docstring); on TPU the Pallas custom-calls are counted natively
         rows, epilogue_counting = substitute_epilogue_analytic(
-            rows, getattr(build_step, "epilogue_sites", []))
+            rows, getattr(build_step, "epilogue_sites", []),
+            getattr(build_step, "residual_sites", []))
         if epilogue_counting:
-            log("fused epilogue counted analytically: %d sites, twin "
-                "rows %.2f GB -> kernels %.2f GB"
+            log("fused kernels counted analytically: %d sites (%s), "
+                "twin rows %.2f GB -> kernels %.2f GB"
                 % (epilogue_counting["sites"],
+                   "+".join(sorted(epilogue_counting["families"])),
                    epilogue_counting["twin_rows_bytes"] / 1e9,
                    epilogue_counting["kernel_bytes_analytic"] / 1e9))
 
@@ -883,7 +926,10 @@ def main() -> None:
                    "width": args.hourglass_inch,
                    "remat": args.remat, "loss_kernel": args.loss_kernel,
                    "param_policy": args.param_policy,
-                   "epilogue": args.epilogue, "amp": True},
+                   "epilogue": args.epilogue,
+                   "block_fuse": getattr(args, "block_fuse", "auto"),
+                   "fwd_dtype": getattr(args, "fwd_dtype", "bf16"),
+                   "amp": True},
         "totals": {"flops": total_flops,
                    "cost_analysis_bytes": total_bytes_ca,
                    "parsed_bytes": summary["total_bytes"]},

@@ -20,12 +20,15 @@ and `--only <section>[,<section>]` reruns just the missing sections
 (inference, train, stack2, remat, stack4_768, step_grid, int8,
 serve).
 
-`step_grid` (ISSUE 2, grown by ISSUE 7) is the (batch x remat x
-loss-kernel x param-policy x epilogue) matrix that picks the
-step-compression default: batches {16, 32, 64} x --remat {none, stacks,
-full} x --loss-kernel {xla, fused} at the fp32/xla baseline, plus the
-ISSUE-7 lever cells (--param-policy bf16-compute and --epilogue fused,
-alone and together) per batch, flagship 512^2 num_stack=1 bf16. The
+`step_grid` (ISSUE 2, grown by ISSUE 7 and ISSUE 20) is the (batch x
+remat x loss-kernel x param-policy x epilogue x block-fuse x fwd-dtype)
+matrix that picks the step-compression default: batches {16, 32, 64} x
+--remat {none, stacks, full} x --loss-kernel {xla, fused} at the
+fp32/xla baseline, plus the ISSUE-7 lever cells (--param-policy
+bf16-compute and --epilogue fused, alone and together) per batch, plus
+the ISSUE-20 lever cells (--block-fuse fused and --fwd-dtype int8,
+alone and together, on the best ISSUE-7 base — the A/B twin is the
+matching cell with the lever off), flagship 512^2 num_stack=1 bf16. The
 record with the best img/s that compiled lands in `step_grid_selected` —
 the artifact `--preset sweep-best` (config.py) promotes to the default
 train flags once committed. Cells resume individually (a mid-sweep kill
@@ -270,12 +273,13 @@ def main() -> None:
 
     def bench_train(num_stack, batch, n, remat, imsize_=None,
                     loss_kernel="auto", param_policy="fp32",
-                    epilogue="auto"):
+                    epilogue="auto", block_fuse="auto", fwd_dtype="bf16"):
         sz = imsize_ or imsize
         cfg = Config(num_stack=num_stack, hourglass_inch=128, num_cls=2,
                      batch_size=batch, amp=True, imsize=sz, remat=remat,
                      loss_kernel=loss_kernel, param_policy=param_policy,
-                     epilogue=epilogue)
+                     epilogue=epilogue, block_fuse=block_fuse,
+                     fwd_dtype=fwd_dtype)
         model = build_model(cfg, dtype=jnp.bfloat16)
         tx = build_optimizer(cfg, 100)
         state = create_train_state(model, cfg, jax.random.key(0), sz, tx)
@@ -296,7 +300,8 @@ def main() -> None:
         # give the donated input an aliasing target, not to be fetched
         dt = timed_fetch(lambda *a: compiled(*a)[1], (state, *arrs),
                          overhead, repeats=1)
-        from real_time_helmet_detection_tpu.models import resolve_epilogue
+        from real_time_helmet_detection_tpu.models import (
+            resolve_block_fuse, resolve_epilogue)
         from real_time_helmet_detection_tpu.train import resolve_loss_kernel
         from bench import bytes_of
         rec = {"batch": batch, "remat": cfg.remat, "imsize": sz,
@@ -304,6 +309,8 @@ def main() -> None:
                "loss_kernel": resolve_loss_kernel(cfg),
                "param_policy": cfg.param_policy,
                "epilogue": resolve_epilogue(cfg),
+               "block_fuse": resolve_block_fuse(cfg),
+               "fwd_dtype": cfg.fwd_dtype,
                "img_per_sec_chip": round(batch * n / dt, 1),
                "step_ms": round(dt / n * 1e3, 3),
                "compile_s": round(compile_s, 1)}
@@ -451,30 +458,41 @@ def main() -> None:
     # big-batch remat=none cells are EXPECTED to OOM — that is the datum
     # that makes remat the batch-32/64 enabler, recorded not skipped.)
     if want("step_grid"):
-        # Cells are (batch, remat, loss_kernel, param_policy, epilogue).
-        # The ISSUE-2 (batch x remat x loss-kernel) matrix keeps its
-        # explicit epilogue="xla" baseline cells; the ISSUE-7 axes ride as
-        # a focused sub-grid (each new lever alone + both together, per
-        # batch) rather than the full 108-cell cross product — the levers
-        # are byte-additive, not interacting, per the roofline class
-        # tables.
+        # Cells are (batch, remat, loss_kernel, param_policy, epilogue,
+        # block_fuse, fwd_dtype). The ISSUE-2 (batch x remat x loss-kernel)
+        # matrix keeps its explicit epilogue="xla" baseline cells; the
+        # ISSUE-7 axes ride as a focused sub-grid (each new lever alone +
+        # both together, per batch) rather than the full 108-cell cross
+        # product — the levers are byte-additive, not interacting, per the
+        # roofline class tables. The ISSUE-20 axes follow the same law:
+        # block-fuse and int8-forward each alone on the best known base
+        # (remat=none, fused loss, fused epilogue), then both together,
+        # per batch — the A/B twin is the matching cell with the lever off.
         if on_tpu:
-            grid = [(b, r, k, "fp32", "xla")
+            grid = [(b, r, k, "fp32", "xla", "xla", "bf16")
                     for b in (16, 32, 64)
                     for r in ("none", "stacks", "full")
                     for k in ("xla", "fused")]
-            grid += [(b, "none", "fused", pp, epi)
+            grid += [(b, "none", "fused", pp, epi, "xla", "bf16")
                      for b in (16, 32, 64)
                      for pp, epi in (("bf16-compute", "xla"),
                                      ("fp32", "fused"),
                                      ("bf16-compute", "fused"))]
+            grid += [(b, "none", "fused", "bf16-compute", "fused", bf, fd)
+                     for b in (16, 32, 64)
+                     for bf, fd in (("fused", "bf16"),
+                                    ("xla", "int8"),
+                                    ("fused", "int8"))]
         else:
-            grid = [(2, "none", "xla", "fp32", "xla"),
-                    (2, "stacks", "fused", "fp32", "xla"),
-                    (2, "full", "fused", "fp32", "xla"),
-                    (2, "none", "xla", "bf16-compute", "xla"),
-                    (2, "none", "xla", "fp32", "fused"),
-                    (2, "none", "xla", "bf16-compute", "fused")]
+            grid = [(2, "none", "xla", "fp32", "xla", "xla", "bf16"),
+                    (2, "stacks", "fused", "fp32", "xla", "xla", "bf16"),
+                    (2, "full", "fused", "fp32", "xla", "xla", "bf16"),
+                    (2, "none", "xla", "bf16-compute", "xla", "xla", "bf16"),
+                    (2, "none", "xla", "fp32", "fused", "xla", "bf16"),
+                    (2, "none", "xla", "bf16-compute", "fused", "xla",
+                     "bf16"),
+                    (2, "none", "xla", "fp32", "xla", "fused", "bf16"),
+                    (2, "none", "xla", "fp32", "xla", "xla", "int8")]
         # per-cell resume (the int8 section's pattern): successful cells
         # from the prior run survive a mid-sweep kill even under
         # `--only step_grid` — only failed/missing cells re-measure
@@ -483,13 +501,16 @@ def main() -> None:
         for r in prior_cells:
             if r not in results["step_grid"]:
                 results["step_grid"].append(r)
+        # pre-ISSUE-20 records lack the new axes: they were measured with
+        # the unfused bf16 step, so they default to the (xla, bf16) cell
         done = {(r.get("batch"), r.get("remat"), r.get("loss_kernel"),
-                 r.get("param_policy", "fp32"), r.get("epilogue", "xla"))
+                 r.get("param_policy", "fp32"), r.get("epilogue", "xla"),
+                 r.get("block_fuse", "xla"), r.get("fwd_dtype", "bf16"))
                 for r in results["step_grid"] if "img_per_sec_chip" in r}
-        for batch, remat, kernel, policy, epilogue in grid:
+        for batch, remat, kernel, policy, epilogue, bfuse, fdt in grid:
             # grid cells are fully explicit (no "auto"), so the raw tuple
             # matches the resolved fields bench_train records
-            cell = (batch, remat, kernel, policy, epilogue)
+            cell = (batch, remat, kernel, policy, epilogue, bfuse, fdt)
             if cell in done:
                 log("step_grid %s already measured; skipping" % (cell,))
                 continue
@@ -497,18 +518,21 @@ def main() -> None:
             try:
                 rec = bench_train(1, batch, n, remat=remat,
                                   loss_kernel=kernel, param_policy=policy,
-                                  epilogue=epilogue)
+                                  epilogue=epilogue, block_fuse=bfuse,
+                                  fwd_dtype=fdt)
                 results["step_grid"].append(rec)
-                log("step_grid b=%d remat=%s loss=%s pp=%s epi=%s: %s"
-                    % (batch, remat, kernel, policy, epilogue, rec))
+                log("step_grid b=%d remat=%s loss=%s pp=%s epi=%s bf=%s "
+                    "fwd=%s: %s" % (batch, remat, kernel, policy, epilogue,
+                                    bfuse, fdt, rec))
             except Exception as e:  # noqa: BLE001
                 results["step_grid"].append(
                     {"batch": batch, "remat": remat, "loss_kernel": kernel,
                      "param_policy": policy, "epilogue": epilogue,
+                     "block_fuse": bfuse, "fwd_dtype": fdt,
                      "error": str(e).splitlines()[-1][:200]})
-                log("step_grid b=%d remat=%s loss=%s pp=%s epi=%s "
-                    "FAILED: %r" % (batch, remat, kernel, policy,
-                                    epilogue, e))
+                log("step_grid b=%d remat=%s loss=%s pp=%s epi=%s bf=%s "
+                    "fwd=%s FAILED: %r" % (batch, remat, kernel, policy,
+                                           epilogue, bfuse, fdt, e))
             flush()
         ok = [r for r in results["step_grid"] if "img_per_sec_chip" in r]
         if ok:

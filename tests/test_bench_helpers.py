@@ -574,3 +574,48 @@ def test_find_last_tpu_result_old_lines_lack_audit_keys(tmp_path):
         "platform": "tpu", "metric": "inference_fps_512", "value": 1100.0})
     got = bench.find_last_tpu_result(root)
     assert "transfer_audit_ok" not in got and "donation_ok" not in got
+
+
+def test_find_last_tpu_result_carries_block_fuse_fields(tmp_path):
+    """ISSUE 20 satellite: block_fuse/fwd_dtype ride find_last_tpu_result
+    (the A/B labels for the step-compression levers), and
+    bench_block_fuse_of hands a consumer the resolved pair."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r18", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1320.0,
+        "mfu_train": 0.60, "block_fuse": "fused", "fwd_dtype": "int8"})
+    got = bench.find_last_tpu_result(root)
+    assert got["block_fuse"] == "fused"
+    assert got["fwd_dtype"] == "int8"
+    assert got["value"] == 1320.0
+    assert bench.bench_block_fuse_of(got) == {
+        "block_fuse": "fused", "fwd_dtype": "int8"}
+
+
+def test_find_last_tpu_result_old_lines_lack_block_fuse_keys(tmp_path):
+    """Pre-ISSUE-20 lines carry neither key and parse as the unfused
+    bf16 step through bench_block_fuse_of (the back-compat contract,
+    same shape as the tier/cascade/stream field defaults)."""
+    root = str(tmp_path)
+    _write_bench_artifact(root, "r09", {
+        "platform": "tpu", "metric": "inference_fps_512", "value": 1100.0})
+    got = bench.find_last_tpu_result(root)
+    assert "block_fuse" not in got and "fwd_dtype" not in got
+    assert bench.bench_block_fuse_of(got) == {
+        "block_fuse": "xla", "fwd_dtype": "bf16"}
+    assert bench.STEP_FUSE_DEFAULTS == {
+        "block_fuse": "xla", "fwd_dtype": "bf16"}
+
+
+def test_sweep_step_grid_block_fuse_cell_identity():
+    """The grown step_grid resume key: a pre-ISSUE-20 record missing the
+    new fields must default to the (xla, bf16) baseline cell rather than
+    colliding with a lever cell."""
+    rec_old = {"batch": 16, "remat": "none", "loss_kernel": "xla",
+               "img_per_sec_chip": 400.0}
+    key = (rec_old.get("batch"), rec_old.get("remat"),
+           rec_old.get("loss_kernel"), rec_old.get("param_policy", "fp32"),
+           rec_old.get("epilogue", "xla"),
+           rec_old.get("block_fuse", "xla"),
+           rec_old.get("fwd_dtype", "bf16"))
+    assert key == (16, "none", "xla", "fp32", "xla", "xla", "bf16")
